@@ -1,0 +1,24 @@
+"""TPU-native few-shot meta-learning framework (MAML / MAML++).
+
+A brand-new JAX/XLA/pjit/Pallas implementation of the capabilities of the
+PyTorch reference ``JMackie80/HowToTrainYourMAMLPytorch`` ("How to train your
+MAML", arXiv:1810.09502): episodic N-way K-shot training/evaluation of MAML and
+MAML++ (second-order inner loops, derivative-order annealing, per-layer
+per-step learnable inner learning rates (LSLR), multi-step loss (MSL),
+per-step batch-norm statistics and weights), plus matching-network and plain
+gradient-descent baselines, a dataset-agnostic deterministic task sampler,
+fault-tolerant checkpoint/resume, CSV/JSON metrics, and top-N checkpoint
+ensemble test evaluation.
+
+Architecture (idiomatic JAX, not a port):
+  * layers are pure ``init``/``apply`` functions over parameter pytrees
+    (the reference's "Meta-layers" with external weight dicts collapse into
+    ordinary functional application);
+  * the inner loop is ``jax.grad`` through a ``lax.scan`` over adaptation
+    steps (second order falls out of differentiating through the scan);
+  * tasks in a meta-batch are ``vmap``-ed (the reference loops tasks in
+    Python) and sharded over a TPU mesh with ``jit``/``shard_map``;
+  * outer-gradient reduction rides ICI via XLA collectives.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,48 @@
+"""Inner-loop optimizers: fixed-LR SGD and the MAML++ LSLR rule.
+
+Capability parity with ``inner_loop_optimizers.py``:
+
+* ``sgd_update`` — the plain differentiable SGD step of
+  ``GradientDescentLearningRule.update_params`` (``inner_loop_optimizers
+  .py:39-52``): ``w' = w - lr * g``, non-mutating so gradients flow through.
+* LSLR (``LSLRGradientDescentLearningRule``, ``:55-113``) — one learnable
+  learning-rate *vector over inner steps* per parameter tensor. The
+  reference stores these in an ``nn.ParameterDict`` keyed by mangled names;
+  here they are simply a pytree with the same structure as the adapted
+  parameters, each leaf an array of shape ``(num_steps + 1,)``.
+
+Parity note: the reference allocates ``num_steps + 1`` learning rates but
+only ever indexes ``0..num_steps-1`` (``inner_loop_optimizers.py:90,110``).
+We keep the ``+ 1`` allocation so checkpoints/param-counts match, and
+likewise never read the last row.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Tree = Any
+
+
+def sgd_update(params: Tree, grads: Tree, learning_rate) -> Tree:
+    """Differentiable SGD: ``w' = w - lr * g`` per leaf."""
+    return jax.tree.map(lambda w, g: w - learning_rate * g, params, grads)
+
+
+def init_lslr(
+    adapt_params: Tree, num_steps: int, init_learning_rate: float, dtype=jnp.float32
+) -> Tree:
+    """Creates the LSLR pytree: per adapted leaf, ``(num_steps + 1,)`` rates
+    initialized to ``init_learning_rate`` (``inner_loop_optimizers.py:86-91``)."""
+    return jax.tree.map(
+        lambda _: jnp.full((num_steps + 1,), init_learning_rate, dtype), adapt_params
+    )
+
+
+def lslr_update(params: Tree, grads: Tree, lslr: Tree, step) -> Tree:
+    """One LSLR step: ``w' = w - lslr[step] * g`` per leaf
+    (``inner_loop_optimizers.py:108-113``). ``step`` may be traced."""
+    return jax.tree.map(lambda w, g, lr: w - lr[step] * g, params, grads, lslr)

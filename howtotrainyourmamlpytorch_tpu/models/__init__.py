@@ -1,0 +1,15 @@
+"""Model families: conv backbone, MAML/MAML++ learner, baselines."""
+
+from .backbone import BackboneConfig, VGGBackbone
+from .maml import MAMLConfig, MAMLFewShotLearner
+from .gradient_descent import GradientDescentLearner
+from .matching_nets import MatchingNetsLearner
+
+__all__ = [
+    "BackboneConfig",
+    "VGGBackbone",
+    "MAMLConfig",
+    "MAMLFewShotLearner",
+    "GradientDescentLearner",
+    "MatchingNetsLearner",
+]

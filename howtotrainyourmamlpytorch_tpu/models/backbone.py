@@ -1,0 +1,266 @@
+"""Functional conv backbone (the reference's ``VGGReLUNormNetwork``).
+
+Capability parity with ``meta_neural_network_architectures.py:542-684``:
+``num_stages`` conv stages of (3x3 conv -> norm -> LeakyReLU [-> 2x2 maxpool])
+followed by a linear head. With ``max_pooling`` the convs are stride 1 and
+each stage ends in a 2x2/2 max pool; otherwise stride-2 convs with a global
+average pool before the head (``:565-570,601-606,644-652``).
+
+Design difference (deliberate, TPU-first): the reference's "Meta-layer"
+external-weights machinery (``extract_top_level_dict`` string surgery over a
+flat name->tensor dict, ``:11-38``) is unnecessary in JAX — parameters are an
+ordinary nested pytree passed to a pure ``apply`` function, so fast weights
+are just a different pytree. Shape inference by dummy-tensor trace (``:578-
+615``) is replaced by static shape computation.
+
+Parameter tree layout::
+
+    params = {
+      "conv0": {"conv": {"weight": (F, C, k, k), "bias": (F,)},
+                "norm": {"gamma": (S, F) | (F,), "beta": (S, F) | (F,)}},
+      ...,
+      "linear": {"weight": (num_classes, feat), "bias": (num_classes,)},
+    }
+    bn_state = {"conv0": BatchNormState, ...}   # batch_norm only
+
+With per-step BN statistics (MAML++), gamma/beta/running stats carry a
+leading ``(num_steps,)`` axis indexed by the inner-loop step — unless
+``enable_inner_loop_optimizable_bn_params`` which reverts gamma/beta to
+``(F,)`` so they can be inner-adapted (reference ``:194-198``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (
+    avg_pool2d,
+    batch_norm,
+    conv2d,
+    layer_norm,
+    linear,
+    max_pool2d,
+    xavier_uniform,
+)
+from ..ops.norm import BatchNormState, init_batch_norm_state
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BackboneConfig:
+    """Static architecture hyperparameters (all config-derived)."""
+
+    num_stages: int = 4
+    num_filters: int = 64
+    kernel_size: int = 3
+    conv_padding: int = 1  # int(bool) like the reference's conv_padding flag
+    max_pooling: bool = True
+    norm_layer: str = "batch_norm"  # or "layer_norm"
+    per_step_bn_statistics: bool = False
+    num_steps: int = 5  # rows of per-step BN arrays
+    enable_inner_loop_optimizable_bn_params: bool = False
+    num_classes: int = 5
+    image_channels: int = 1
+    image_height: int = 28
+    image_width: int = 28
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+
+    @property
+    def conv_stride(self) -> int:
+        return 1 if self.max_pooling else 2
+
+    def stage_spatial_shapes(self) -> list[tuple[int, int]]:
+        """Post-stage (H, W) per stage, matching torch floor-division conv
+        and VALID 2x2 pooling arithmetic."""
+        h, w = self.image_height, self.image_width
+        shapes = []
+        for _ in range(self.num_stages):
+            h = (h + 2 * self.conv_padding - self.kernel_size) // self.conv_stride + 1
+            w = (w + 2 * self.conv_padding - self.kernel_size) // self.conv_stride + 1
+            if self.max_pooling:
+                h, w = h // 2, w // 2
+            shapes.append((h, w))
+        return shapes
+
+    @property
+    def feature_dim(self) -> int:
+        """Flattened feature size entering the linear head."""
+        if self.max_pooling:
+            h, w = self.stage_spatial_shapes()[-1]
+            return self.num_filters * h * w
+        return self.num_filters  # global average pool -> (F, 1, 1)
+
+    @property
+    def per_step_affine(self) -> bool:
+        """Whether gamma/beta carry the per-step axis."""
+        return (
+            self.per_step_bn_statistics
+            and self.norm_layer == "batch_norm"
+            and not self.enable_inner_loop_optimizable_bn_params
+        )
+
+
+class VGGBackbone:
+    """Pure-functional backbone: ``init`` makes pytrees, ``apply`` runs them."""
+
+    def __init__(self, cfg: BackboneConfig):
+        self.cfg = cfg
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> tuple[Params, Params]:
+        """Initializes ``(params, bn_state)``.
+
+        Conv/linear weights are Xavier-uniform, biases zero, BN gamma ones and
+        beta zeros — matching the reference's init choices
+        (``meta_neural_network_architectures.py:62-66,115-118,177-198``).
+        """
+        cfg = self.cfg
+        params: Params = {}
+        bn_state: Params = {}
+        in_ch = cfg.image_channels
+        spatial = [(cfg.image_height, cfg.image_width)] + cfg.stage_spatial_shapes()
+        keys = jax.random.split(key, cfg.num_stages + 1)
+
+        for i in range(cfg.num_stages):
+            stage: Params = {
+                "conv": {
+                    "weight": xavier_uniform(
+                        keys[i],
+                        (cfg.num_filters, in_ch, cfg.kernel_size, cfg.kernel_size),
+                        dtype,
+                    ),
+                    "bias": jnp.zeros((cfg.num_filters,), dtype),
+                }
+            }
+            if cfg.norm_layer == "batch_norm":
+                affine_shape = (
+                    (cfg.num_steps, cfg.num_filters)
+                    if cfg.per_step_affine
+                    else (cfg.num_filters,)
+                )
+                stage["norm"] = {
+                    "gamma": jnp.ones(affine_shape, dtype),
+                    "beta": jnp.zeros(affine_shape, dtype),
+                }
+                bn_state[f"conv{i}"] = init_batch_norm_state(
+                    cfg.num_filters,
+                    cfg.num_steps if cfg.per_step_bn_statistics else None,
+                    dtype,
+                )
+            elif cfg.norm_layer == "layer_norm":
+                # Normalized shape is the full (C, H, W) activation like the
+                # reference (``meta_neural_network_architectures.py:379``).
+                h, w = self._pre_pool_shape(i)
+                stage["norm"] = {
+                    "weight": jnp.ones((cfg.num_filters, h, w), dtype),
+                    "bias": jnp.zeros((cfg.num_filters, h, w), dtype),
+                }
+            params[f"conv{i}"] = stage
+            in_ch = cfg.num_filters
+
+        params["linear"] = {
+            "weight": xavier_uniform(keys[-1], (cfg.num_classes, cfg.feature_dim), dtype),
+            "bias": jnp.zeros((cfg.num_classes,), dtype),
+        }
+        return params, bn_state
+
+    def _pre_pool_shape(self, stage: int) -> tuple[int, int]:
+        """(H, W) right after the conv of ``stage`` (pre max-pool)."""
+        cfg = self.cfg
+        h, w = cfg.image_height, cfg.image_width
+        for i in range(stage + 1):
+            h = (h + 2 * cfg.conv_padding - cfg.kernel_size) // cfg.conv_stride + 1
+            w = (w + 2 * cfg.conv_padding - cfg.kernel_size) // cfg.conv_stride + 1
+            if cfg.max_pooling and i < stage:
+                h, w = h // 2, w // 2
+        return h, w
+
+    def apply(
+        self,
+        params: Params,
+        bn_state: Params,
+        x: jax.Array,
+        step,
+        *,
+        training: bool = True,
+    ) -> tuple[jax.Array, Params]:
+        """Forward pass.
+
+        Args:
+          params: Parameter pytree (possibly containing fast weights).
+          bn_state: Running-stat pytree (empty dict for layer_norm).
+          x: Images ``(N, C, H, W)``.
+          step: Inner-loop step index (selects per-step BN rows).
+          training: Kept for API symmetry; like the reference, normalization
+            always uses batch statistics regardless of phase
+            (``meta_neural_network_architectures.py:246-247``).
+
+        Returns:
+          ``(logits (N, num_classes), new_bn_state)``.
+        """
+        del training
+        cfg = self.cfg
+        new_bn_state: Params = {}
+        out = x
+        for i in range(cfg.num_stages):
+            stage = params[f"conv{i}"]
+            out = conv2d(
+                out,
+                stage["conv"]["weight"],
+                stage["conv"]["bias"],
+                stride=cfg.conv_stride,
+                padding=cfg.conv_padding,
+            )
+            if cfg.norm_layer == "batch_norm":
+                out, new_bn_state[f"conv{i}"] = batch_norm(
+                    out,
+                    stage["norm"]["gamma"],
+                    stage["norm"]["beta"],
+                    bn_state[f"conv{i}"],
+                    step,
+                    momentum=cfg.bn_momentum,
+                    eps=cfg.bn_eps,
+                )
+            elif cfg.norm_layer == "layer_norm":
+                out = layer_norm(
+                    out, stage["norm"]["weight"], stage["norm"]["bias"], eps=cfg.bn_eps
+                )
+            out = jax.nn.leaky_relu(out, negative_slope=0.01)
+            if cfg.max_pooling:
+                out = max_pool2d(out, 2, 2)
+
+        if not cfg.max_pooling:
+            out = avg_pool2d(out, out.shape[2])
+
+        out = out.reshape(out.shape[0], -1)
+        logits = linear(out, params["linear"]["weight"], params["linear"]["bias"])
+        return logits, new_bn_state
+
+    # ------------------------------------------------------------------
+    # Inner-loop parameter partition
+    # ------------------------------------------------------------------
+
+    def inner_loop_mask(self, params: Params) -> Params:
+        """Boolean pytree marking leaves adapted in the inner loop.
+
+        Mirrors ``get_inner_loop_parameter_dict`` (``few_shot_learning_system
+        .py:105-120``): all trainable params EXCEPT normalization-layer
+        params, unless ``enable_inner_loop_optimizable_bn_params``.
+        """
+        enable_bn = self.cfg.enable_inner_loop_optimizable_bn_params
+
+        def mark(path: tuple[str, ...], _leaf) -> bool:
+            return enable_bn or "norm" not in path
+
+        return _map_with_path(mark, params)
+
+
+def _map_with_path(fn, tree: Params, path: tuple[str, ...] = ()) -> Params:
+    if isinstance(tree, dict):
+        return {k: _map_with_path(fn, v, path + (k,)) for k, v in tree.items()}
+    return fn(path, tree)

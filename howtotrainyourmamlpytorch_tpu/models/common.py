@@ -1,0 +1,65 @@
+"""Shared trainer plumbing: batch prep, epoch-wise cosine LR, injected Adam.
+
+The reference steps its torch ``CosineAnnealingLR`` with the *explicit epoch
+index* every iteration (``few_shot_learning_system.py:346``,
+``gradient_descent.py:206``, ``matching_nets.py:221``), making the LR a pure
+function of the passed epoch. All learners here reproduce that by computing
+the LR host-side from the epoch and injecting it into an
+``optax.inject_hyperparams`` optimizer state before each update.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def cosine_epoch_lr(
+    epoch: int, meta_learning_rate: float, min_learning_rate: float, total_epochs: int
+) -> float:
+    """``eta_min + (lr0 - eta_min) * (1 + cos(pi * epoch / T_max)) / 2`` —
+    torch ``CosineAnnealingLR`` closed form, piecewise-constant per epoch."""
+    frac = min(epoch / total_epochs, 1.0)
+    return min_learning_rate + 0.5 * (meta_learning_rate - min_learning_rate) * (
+        1.0 + math.cos(math.pi * frac)
+    )
+
+
+def make_injected_adam(
+    learning_rate: float, clip_grad_value: float | None = None
+) -> optax.GradientTransformation:
+    """Adam (torch defaults) with a runtime-settable learning rate; optional
+    elementwise grad clamp first (the reference's ±10 ImageNet clamp,
+    ``few_shot_learning_system.py:332-335``)."""
+
+    @optax.inject_hyperparams
+    def make(learning_rate):
+        adam = optax.adam(learning_rate)
+        if clip_grad_value is not None:
+            return optax.chain(optax.clip(clip_grad_value), adam)
+        return adam
+
+    return make(learning_rate)
+
+
+def set_injected_lr(opt_state, lr: float):
+    """Writes the learning rate into an ``inject_hyperparams`` state (host-
+    side, before the jitted update reads it)."""
+    opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    return opt_state
+
+
+def prepare_batch(data_batch):
+    """(B, N, K, C, H, W) numpy episode batch -> flattened device-ready
+    arrays, mirroring the reference's ``view(-1, c, h, w)``
+    (``few_shot_learning_system.py:208-213``)."""
+    xs, xt, ys, yt = data_batch
+    xs, xt = np.asarray(xs, np.float32), np.asarray(xt, np.float32)
+    ys, yt = np.asarray(ys, np.int32), np.asarray(yt, np.int32)
+    b = xs.shape[0]
+    xs = xs.reshape(b, -1, *xs.shape[-3:])
+    xt = xt.reshape(b, -1, *xt.shape[-3:])
+    return xs, xt, ys.reshape(b, -1), yt.reshape(b, -1)

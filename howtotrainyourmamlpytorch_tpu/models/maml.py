@@ -1,0 +1,479 @@
+"""MAML / MAML++ few-shot learning system, TPU-native.
+
+Capability parity with the reference's ``MAMLFewShotClassifier``
+(``few_shot_learning_system.py:26-424``), redesigned for XLA:
+
+* the reference's sequential per-task Python loop (``few_shot_learning_system
+  .py:193``) becomes ``jax.vmap`` over the task axis of the meta-batch;
+* fast-weight adaptation via ``torch.autograd.grad(create_graph=
+  use_second_order)`` (``:138-139``) becomes ``jax.grad`` inside a
+  ``lax.scan`` over inner steps — second order falls out of differentiating
+  through the scan, first order is a ``stop_gradient`` on the inner grads;
+* per-step BN statistics ride the scan carry; because the reference always
+  normalizes with batch statistics (see ``ops/norm.py``), running stats are
+  diagnostic state that we mean-reduce over tasks after the step;
+* the outer Adam + cosine annealing + (ImageNet) elementwise grad clamp
+  (``:69-71,332-336``) becomes an ``optax`` chain with a per-epoch cosine
+  schedule, with non-learnable leaves (LSLR when not learnable, BN
+  gamma/beta when frozen, layer-norm weight) masked to zero update via
+  ``optax.multi_transform`` — the functional equivalent of torch's
+  ``requires_grad=False``;
+* MSL per-step loss weighting with annealed importance (``:83-103,232-244``)
+  is a host-computed importance vector contracted with the per-step target
+  losses (one-hot on the final step when MSL is off or past its epoch
+  horizon);
+* derivative-order annealing (``:304-305``) selects between two compiled
+  train-step variants by epoch on the host.
+
+Memory: each inner step is wrapped in ``jax.checkpoint`` (remat) so the
+second-order graph stores only per-step boundaries — the TPU answer to the
+reference's small-meta-batch workaround (SURVEY §5 "long-context").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..inner_loop import init_lslr, lslr_update
+from ..ops import accuracy, cross_entropy
+from ..utils.trees import merge, partition
+from .backbone import BackboneConfig, VGGBackbone
+from .common import cosine_epoch_lr, prepare_batch, set_injected_lr
+
+Tree = Any
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MAMLConfig:
+    """Static training hyperparameters (reference flags, SURVEY §5 C19)."""
+
+    backbone: BackboneConfig = dataclasses.field(default_factory=BackboneConfig)
+
+    # Inner loop
+    number_of_training_steps_per_iter: int = 5
+    number_of_evaluation_steps_per_iter: int = 5
+    task_learning_rate: float = 0.1  # LSLR init (few_shot_learning_system.py:46-51)
+    learnable_per_layer_per_step_inner_loop_learning_rate: bool = True
+    second_order: bool = True
+    first_order_to_second_order_epoch: int = -1
+
+    # MSL
+    use_multi_step_loss_optimization: bool = True
+    multi_step_loss_num_epochs: int = 10
+
+    # Outer loop
+    meta_learning_rate: float = 0.001
+    min_learning_rate: float = 1e-5
+    total_epochs: int = 100
+    total_iter_per_epoch: int = 500
+    clip_grad_value: float | None = None  # +-10 elementwise when 'imagenet' in dataset
+
+    # BN learnability (torch requires_grad equivalents)
+    learnable_bn_gamma: bool = True
+    learnable_bn_beta: bool = True
+
+    # TPU-specific
+    remat_inner_steps: bool = True
+    compute_dtype: str = "float32"  # "bfloat16" runs the net in bf16 on the MXU
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
+
+    def __post_init__(self):
+        # Per-step BN arrays are sized by the backbone's num_steps; the
+        # reference sizes them from number_of_training_steps_per_iter
+        # (meta_neural_network_architectures.py:177-185). A mismatch would
+        # silently collapse per-step BN onto the last row (ops/norm.py
+        # clamps), so refuse it outright.
+        if (
+            self.backbone.per_step_bn_statistics
+            and self.backbone.num_steps != self.number_of_training_steps_per_iter
+        ):
+            raise ValueError(
+                "backbone.num_steps"
+                f" ({self.backbone.num_steps}) must equal"
+                " number_of_training_steps_per_iter"
+                f" ({self.number_of_training_steps_per_iter}) when"
+                " per_step_bn_statistics is on"
+            )
+
+
+def per_step_loss_importance(
+    epoch: int, num_steps: int, msl_num_epochs: int
+) -> np.ndarray:
+    """MSL importance vector with the reference's exact annealing math
+    (``few_shot_learning_system.py:83-103``): early-step weights decay
+    linearly to a floor while the final step's weight grows to the ceiling."""
+    weights = np.ones(num_steps, np.float32) * (1.0 / num_steps)
+    decay = 1.0 / num_steps / msl_num_epochs
+    min_nonfinal = 0.03 / num_steps
+    for i in range(num_steps - 1):
+        weights[i] = max(weights[i] - epoch * decay, min_nonfinal)
+    weights[-1] = min(
+        weights[-1] + epoch * (num_steps - 1) * decay,
+        1.0 - (num_steps - 1) * min_nonfinal,
+    )
+    return weights
+
+
+def final_step_importance(num_steps: int, final_index: int | None = None) -> np.ndarray:
+    """One-hot importance selecting a single step's target loss — the non-MSL
+    branch (``few_shot_learning_system.py:239-244``)."""
+    weights = np.zeros(num_steps, np.float32)
+    weights[final_index if final_index is not None else num_steps - 1] = 1.0
+    return weights
+
+
+# ---------------------------------------------------------------------------
+# Train state
+# ---------------------------------------------------------------------------
+
+
+class TrainState(NamedTuple):
+    """Everything checkpointed, as one pytree (SURVEY §5 checkpoint format)."""
+
+    theta: Tree  # backbone parameters
+    lslr: Tree  # per-leaf per-step inner learning rates
+    bn_state: Tree  # per-step BN running stats (diagnostic)
+    opt_state: Tree
+    iteration: jax.Array  # outer iterations taken (drives the LR schedule)
+
+
+class MAMLFewShotLearner:
+    """The MAML/MAML++ trainer: owns config, backbone, optimizer, and the
+    compiled train/eval step functions.
+
+    Follows the reference trainer contract (``run_train_iter``,
+    ``run_validation_iter``) so the experiment runtime is model-agnostic.
+    """
+
+    def __init__(self, cfg: MAMLConfig, mesh: jax.sharding.Mesh | None = None):
+        self.cfg = cfg
+        self.backbone = VGGBackbone(cfg.backbone)
+        self.tx = self._make_optimizer()
+        self.mesh = mesh
+        self.current_epoch = 0
+
+        jit_kwargs = {}
+        if mesh is not None:
+            from ..parallel.mesh import batch_sharding, replicated
+
+            # State and importance replicated; the task axis of every batch
+            # array sharded over the mesh's data axis ('dp'). XLA inserts the
+            # outer-grad all-reduce over ICI automatically.
+            jit_kwargs["in_shardings"] = (
+                replicated(mesh),
+                batch_sharding(mesh),
+                replicated(mesh),
+            )
+
+        self._train_step_so = jax.jit(
+            functools.partial(self._train_step, second_order=True),
+            donate_argnums=(0,),
+            **jit_kwargs,
+        )
+        self._train_step_fo = jax.jit(
+            functools.partial(self._train_step, second_order=False),
+            donate_argnums=(0,),
+            **jit_kwargs,
+        )
+        self._eval_step = jax.jit(self._evaluation_step, **jit_kwargs)
+
+    # ------------------------------------------------------------------
+    # Initialization
+    # ------------------------------------------------------------------
+
+    def init_state(self, key: jax.Array) -> TrainState:
+        theta, bn_state = self.backbone.init(key, dtype=jnp.float32)
+        mask = self.backbone.inner_loop_mask(theta)
+        adapt, _ = partition(theta, mask)
+        lslr = init_lslr(
+            adapt,
+            self.cfg.number_of_training_steps_per_iter,
+            self.cfg.task_learning_rate,
+        )
+        opt_state = self.tx.init({"theta": theta, "lslr": lslr})
+        return TrainState(
+            theta=theta,
+            lslr=lslr,
+            bn_state=bn_state,
+            opt_state=opt_state,
+            iteration=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------------
+    # Outer optimizer
+    # ------------------------------------------------------------------
+
+    def _epoch_lr(self, epoch: int) -> float:
+        """The LR is a pure function of the *passed* epoch, exactly like the
+        reference's ``scheduler.step(epoch=epoch)`` every iteration
+        (``few_shot_learning_system.py:346``)."""
+        cfg = self.cfg
+        return cosine_epoch_lr(
+            epoch, cfg.meta_learning_rate, cfg.min_learning_rate, cfg.total_epochs
+        )
+
+    def _make_optimizer(self) -> optax.GradientTransformation:
+        cfg = self.cfg
+        self._label_fn = self._make_label_fn()
+        label_fn = self._label_fn
+
+        @optax.inject_hyperparams
+        def make(learning_rate):
+            adam = optax.adam(learning_rate)
+            if cfg.clip_grad_value is not None:
+                trainable = optax.chain(optax.clip(cfg.clip_grad_value), adam)
+            else:
+                trainable = adam
+            return optax.multi_transform(
+                {"trainable": trainable, "frozen": optax.set_to_zero()}, label_fn
+            )
+
+        return make(cfg.meta_learning_rate)
+
+    def _make_label_fn(self):
+        cfg = self.cfg
+
+        def labels(outer: Tree) -> Tree:
+            def theta_label(path: tuple[str, ...], _leaf) -> str:
+                if "norm" in path:
+                    if cfg.backbone.norm_layer == "layer_norm" and path[-1] == "weight":
+                        return "frozen"  # LN weight frozen (meta_nn...py:279)
+                    if path[-1] == "gamma" and not cfg.learnable_bn_gamma:
+                        return "frozen"
+                    if path[-1] == "beta" and not cfg.learnable_bn_beta:
+                        return "frozen"
+                return "trainable"
+
+            lslr_label = (
+                "trainable"
+                if cfg.learnable_per_layer_per_step_inner_loop_learning_rate
+                else "frozen"
+            )
+            from .backbone import _map_with_path
+
+            return {
+                "theta": _map_with_path(theta_label, outer["theta"]),
+                "lslr": jax.tree.map(lambda _: lslr_label, outer["lslr"]),
+            }
+
+        return labels
+
+    # ------------------------------------------------------------------
+    # Inner loop (one task)
+    # ------------------------------------------------------------------
+
+    def _task_adapt_and_losses(
+        self,
+        theta: Tree,
+        lslr: Tree,
+        bn_state: Tree,
+        x_support: jax.Array,
+        y_support: jax.Array,
+        x_target: jax.Array,
+        y_target: jax.Array,
+        importance: jax.Array,
+        num_steps: int,
+        second_order: bool,
+        pred_step: int | None = None,
+    ):
+        """Inner-loop adaptation + per-step target losses for ONE task.
+
+        Returns ``(weighted_loss, aux)`` where aux carries the final-step
+        target logits, accuracy, and the evolved BN state.
+        """
+        backbone = self.backbone
+        mask = backbone.inner_loop_mask(theta)
+        adapt0, frozen = partition(theta, mask)
+        compute_dtype = self.cfg.dtype
+        x_support = x_support.astype(compute_dtype)
+        x_target = x_target.astype(compute_dtype)
+
+        def step_fn(carry, step):
+            fast, bn = carry
+
+            def support_loss_fn(fast_):
+                logits, bn1 = backbone.apply(merge(fast_, frozen), bn, x_support, step)
+                return cross_entropy(logits, y_support), bn1
+
+            (s_loss, bn1), grads = jax.value_and_grad(support_loss_fn, has_aux=True)(
+                fast
+            )
+            if not second_order:
+                grads = lax.stop_gradient(grads)
+            fast = lslr_update(fast, grads, lslr, step)
+            t_logits, bn2 = backbone.apply(merge(fast, frozen), bn1, x_target, step)
+            t_loss = cross_entropy(t_logits, y_target)
+            return (fast, bn2), (s_loss, t_loss, t_logits)
+
+        if self.cfg.remat_inner_steps:
+            step_fn = jax.checkpoint(step_fn)
+
+        (fast_final, bn_final), (s_losses, t_losses, t_logits) = lax.scan(
+            step_fn, (adapt0, bn_state), jnp.arange(num_steps)
+        )
+        del fast_final
+        weighted = jnp.sum(importance * t_losses)
+        # Predictions/accuracy come from the same step whose target loss is
+        # reported: the final step in training; at eval, the reference's
+        # final-loss condition fires at the *training* step count
+        # (few_shot_learning_system.py:239), so pred_step may differ.
+        pred_step = num_steps - 1 if pred_step is None else pred_step
+        final_logits = t_logits[pred_step].astype(jnp.float32)
+        acc = accuracy(final_logits, y_target)
+        return weighted, dict(
+            logits=final_logits,
+            accuracy=acc,
+            bn_state=bn_final,
+            support_losses=s_losses,
+            target_losses=t_losses,
+        )
+
+    # ------------------------------------------------------------------
+    # Meta (outer) step over the vmapped task batch
+    # ------------------------------------------------------------------
+
+    def _meta_loss(
+        self,
+        outer: Tree,
+        bn_state: Tree,
+        batch,
+        importance,
+        num_steps,
+        second_order,
+        pred_step: int | None = None,
+    ):
+        xs, xt, ys, yt = batch  # (B, N*K, C, H, W), ..., (B, N*K), (B, N*T)
+        per_task = functools.partial(
+            self._task_adapt_and_losses,
+            num_steps=num_steps,
+            second_order=second_order,
+            pred_step=pred_step,
+        )
+        weighted, aux = jax.vmap(
+            per_task, in_axes=(None, None, None, 0, 0, 0, 0, None)
+        )(outer["theta"], outer["lslr"], bn_state, xs, ys, xt, yt, importance)
+        # Mean over tasks (few_shot_learning_system.py:164)
+        return jnp.mean(weighted), aux
+
+    def _train_step(self, state: TrainState, batch, importance, *, second_order):
+        outer = {"theta": state.theta, "lslr": state.lslr}
+        (loss, aux), grads = jax.value_and_grad(self._meta_loss, has_aux=True)(
+            outer, state.bn_state, batch, importance,
+            self.cfg.number_of_training_steps_per_iter, second_order,
+        )
+        updates, opt_state = self.tx.update(grads, state.opt_state, outer)
+        outer = optax.apply_updates(outer, updates)
+        # Running stats evolved per task in parallel; mean-reduce across tasks.
+        # (Sequential accumulation in the reference is incidental statefulness
+        # with no effect on any output — see ops/norm.py.)
+        bn_state = jax.tree.map(lambda s: jnp.mean(s, axis=0), aux["bn_state"])
+        new_state = TrainState(
+            theta=outer["theta"],
+            lslr=outer["lslr"],
+            bn_state=bn_state,
+            opt_state=opt_state,
+            iteration=state.iteration + 1,
+        )
+        metrics = dict(loss=loss, accuracy=jnp.mean(aux["accuracy"]))
+        return new_state, metrics
+
+    def _evaluation_step(self, state: TrainState, batch, importance):
+        """Adaptation + final-step target evaluation; BN state is discarded
+        (the functional form of the reference's backup/restore,
+        ``few_shot_learning_system.py:254-255``). Always first order
+        (``:318``)."""
+        cfg = self.cfg
+        outer = {"theta": state.theta, "lslr": state.lslr}
+        pred_step = (
+            min(
+                cfg.number_of_training_steps_per_iter,
+                cfg.number_of_evaluation_steps_per_iter,
+            )
+            - 1
+        )
+        loss, aux = self._meta_loss(
+            outer, state.bn_state, batch, importance,
+            cfg.number_of_evaluation_steps_per_iter, False, pred_step,
+        )
+        metrics = dict(loss=loss, accuracy=jnp.mean(aux["accuracy"]))
+        return metrics, aux["logits"]
+
+    # ------------------------------------------------------------------
+    # Reference trainer contract
+    # ------------------------------------------------------------------
+
+    def _use_second_order(self, epoch: int) -> bool:
+        # few_shot_learning_system.py:304-305
+        return self.cfg.second_order and epoch > self.cfg.first_order_to_second_order_epoch
+
+    def _train_importance(self, epoch: int) -> np.ndarray:
+        cfg = self.cfg
+        n = cfg.number_of_training_steps_per_iter
+        if cfg.use_multi_step_loss_optimization and epoch < cfg.multi_step_loss_num_epochs:
+            return per_step_loss_importance(epoch, n, cfg.multi_step_loss_num_epochs)
+        return final_step_importance(n)
+
+    def _eval_importance(self) -> np.ndarray:
+        # Eval never takes the MSL branch (training_phase gate at :232): only
+        # the target loss at the *training* final-step index counts (:239).
+        cfg = self.cfg
+        n_eval = cfg.number_of_evaluation_steps_per_iter
+        idx = min(cfg.number_of_training_steps_per_iter, n_eval) - 1
+        return final_step_importance(n_eval, idx)
+
+    _prepare_batch = staticmethod(prepare_batch)
+
+    def run_train_iter(self, state: TrainState, data_batch, epoch):
+        """One meta-update. Returns ``(new_state, losses_dict)`` with the
+        reference's metric keys (``few_shot_learning_system.py:338-369``)."""
+        epoch = int(epoch)
+        self.current_epoch = epoch
+        batch = self._prepare_batch(data_batch)
+        importance = self._train_importance(epoch)
+        lr = self._epoch_lr(epoch)
+        state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
+        step_fn = (
+            self._train_step_so if self._use_second_order(epoch) else self._train_step_fo
+        )
+        new_state, metrics = step_fn(state, batch, importance)
+        losses = {
+            "loss": float(metrics["loss"]),
+            "accuracy": float(metrics["accuracy"]),
+        }
+        msl_vector = per_step_loss_importance(
+            epoch,
+            self.cfg.number_of_training_steps_per_iter,
+            self.cfg.multi_step_loss_num_epochs,
+        )
+        for i, v in enumerate(msl_vector):
+            losses[f"loss_importance_vector_{i}"] = float(v)
+        losses["learning_rate"] = lr
+        return new_state, losses
+
+    def run_validation_iter(self, state: TrainState, data_batch):
+        """Evaluation episode batch. Returns ``(state, losses_dict,
+        per_task_preds)``; state is returned unchanged (pure eval — the
+        functional form of the reference's BN backup/restore)."""
+        batch = self._prepare_batch(data_batch)
+        metrics, logits = self._eval_step(state, batch, self._eval_importance())
+        losses = {
+            "loss": float(metrics["loss"]),
+            "accuracy": float(metrics["accuracy"]),
+        }
+        return state, losses, np.asarray(logits)

@@ -1,0 +1,26 @@
+"""Functional TPU ops: conv, linear, norms, pooling, losses, initializers.
+
+These are the XLA-native equivalents of the reference's implicit cuDNN/ATen
+surface (reference ``meta_neural_network_architectures.py:89,141,246`` etc.).
+Everything is a pure function of explicit parameters — no modules, no state.
+"""
+
+from .conv import conv2d
+from .linear import linear
+from .norm import batch_norm, layer_norm, BatchNormState
+from .pool import max_pool2d, avg_pool2d
+from .losses import cross_entropy, accuracy
+from .initializers import xavier_uniform
+
+__all__ = [
+    "conv2d",
+    "linear",
+    "batch_norm",
+    "layer_norm",
+    "BatchNormState",
+    "max_pool2d",
+    "avg_pool2d",
+    "cross_entropy",
+    "accuracy",
+    "xavier_uniform",
+]

@@ -1,0 +1,49 @@
+"""2D convolution as an XLA primitive (MXU-friendly).
+
+TPU-native equivalent of the reference's ``F.conv2d`` call
+(``meta_neural_network_architectures.py:89-97``). Uses
+``lax.conv_general_dilated`` which XLA tiles directly onto the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d(
+    x: jax.Array,
+    weight: jax.Array,
+    bias: jax.Array | None = None,
+    *,
+    stride: int = 1,
+    padding: int = 0,
+    dilation: int = 1,
+    groups: int = 1,
+) -> jax.Array:
+    """Applies a 2D convolution.
+
+    Args:
+      x: Input batch of shape ``(N, C, H, W)``.
+      weight: Filters of shape ``(O, I, kH, kW)`` (same layout the reference
+        stores, ``meta_neural_network_architectures.py:62``).
+      bias: Optional per-output-channel bias ``(O,)``.
+      stride / padding / dilation / groups: Standard conv hyperparameters
+        (symmetric integer padding, like ``F.conv2d``).
+
+    Returns:
+      Output of shape ``(N, O, H', W')``.
+    """
+    out = lax.conv_general_dilated(
+        x,
+        weight.astype(x.dtype),  # params stored fp32; compute may be bf16
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        rhs_dilation=(dilation, dilation),
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # bf16 inputs accumulate in fp32 on the MXU; no explicit cast needed
+    if bias is not None:
+        out = out + bias.astype(out.dtype)[None, :, None, None]
+    return out.astype(x.dtype)

@@ -1,0 +1,29 @@
+"""Weight initializers matching the reference's choices.
+
+The reference uses ``nn.init.xavier_uniform_`` for conv and linear weights
+(``meta_neural_network_architectures.py:63,116``) and zeros for biases.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: tuple[int, ...]) -> tuple[int, int]:
+    if len(shape) == 2:  # (out, in) — torch linear layout
+        fan_out, fan_in = shape
+    else:  # (out, in, kh, kw) — torch conv layout
+        receptive = math.prod(shape[2:])
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def xavier_uniform(key: jax.Array, shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
+    """Glorot/Xavier uniform with gain 1 over torch-layout shapes."""
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)

@@ -1,0 +1,25 @@
+"""Dense layer as a plain MXU matmul.
+
+TPU-native equivalent of the reference's ``F.linear``
+(``meta_neural_network_architectures.py:141``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x: jax.Array, weight: jax.Array, bias: jax.Array | None = None) -> jax.Array:
+    """Computes ``x @ weight.T + bias``.
+
+    Args:
+      x: ``(..., in_features)``.
+      weight: ``(out_features, in_features)`` — same layout the reference
+        stores so checkpoints map 1:1.
+      bias: Optional ``(out_features,)``.
+    """
+    out = jnp.dot(x, weight.astype(x.dtype).T)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out.astype(x.dtype)

@@ -1,0 +1,23 @@
+"""Classification losses/metrics.
+
+TPU-native equivalent of the reference's ``F.cross_entropy`` call sites
+(``few_shot_learning_system.py:284``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels (torch semantics)."""
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean argmax accuracy (reference ``few_shot_learning_system.py:247-249``)."""
+    preds = jnp.argmax(logits, axis=-1)
+    return jnp.mean((preds == labels.astype(preds.dtype)).astype(jnp.float32))

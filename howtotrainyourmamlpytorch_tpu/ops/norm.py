@@ -1,0 +1,138 @@
+"""Functional batch/layer normalization with explicit (per-step) state.
+
+TPU-native equivalent of the reference's ``MetaBatchNormLayer`` /
+``MetaLayerNormLayer`` (``meta_neural_network_architectures.py:143-322``).
+
+Semantics preserved exactly:
+
+* The reference ALWAYS calls ``F.batch_norm(..., training=True)``
+  (``meta_neural_network_architectures.py:246-247``), i.e. activations are
+  normalized with the *current batch* statistics in both training and
+  evaluation, and running statistics are updated as a side effect.
+  Consequence (made explicit here): **running statistics never influence any
+  output** — they are pure diagnostic/checkpoint state. The reference's
+  backup/restore-running-stats dance around eval episodes
+  (``few_shot_learning_system.py:254-255``) is therefore implemented by
+  simply *discarding* the returned state at eval time.
+* With per-step statistics (MAML++ "BNWB"), running mean/var and the
+  learnable gamma/beta all carry a leading ``(num_steps,)`` axis indexed by
+  the inner-loop step (``meta_neural_network_architectures.py:177-185,
+  226-234``).
+* Running stats update follows torch: biased variance normalizes the batch,
+  *unbiased* variance feeds the running average, with
+  ``new = (1 - momentum) * old + momentum * batch_stat``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BatchNormState(NamedTuple):
+    """Running statistics (diagnostic only — see module docstring).
+
+    With per-step statistics both arrays have shape ``(num_steps, features)``;
+    otherwise ``(features,)``.
+    """
+
+    running_mean: jax.Array
+    running_var: jax.Array
+
+
+def init_batch_norm_state(
+    num_features: int, num_steps: int | None = None, dtype=jnp.float32
+) -> BatchNormState:
+    """Zero-mean / unit-var initial running stats.
+
+    Note the reference's non-per-step branch initializes running_var to zeros
+    (``meta_neural_network_architectures.py:188``) — harmless there because the
+    stats are never read; we initialize to ones (the principled value) since
+    the stats are equally never read here.
+    """
+    shape = (num_features,) if num_steps is None else (num_steps, num_features)
+    return BatchNormState(
+        running_mean=jnp.zeros(shape, dtype), running_var=jnp.ones(shape, dtype)
+    )
+
+
+def batch_norm(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    state: BatchNormState,
+    step,
+    *,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+) -> tuple[jax.Array, BatchNormState]:
+    """Batch normalization over ``(N, C, H, W)`` with batch statistics.
+
+    Args:
+      x: Input activations ``(N, C, H, W)``.
+      gamma / beta: Scale/shift. Either ``(C,)`` or per-step ``(S, C)``; the
+        per-step variants are indexed with ``step``.
+      state: Running stats; either ``(C,)`` or per-step ``(S, C)`` arrays.
+      step: Inner-loop step index (traced scalar ok). Clamped to the stored
+        number of steps, so evaluating with more inner steps than stored rows
+        reuses the final row instead of indexing out of bounds.
+      momentum / eps: As in torch ``F.batch_norm``.
+
+    Returns:
+      ``(normalized, new_state)`` — caller decides whether to thread or
+      discard ``new_state`` (training vs eval episode).
+    """
+    per_step_state = state.running_mean.ndim == 2
+    step = jnp.asarray(step)
+
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32)  # statistics always in fp32 (bf16-safe)
+    reduce_axes = (0, 2, 3)
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    mean = jnp.mean(x, axis=reduce_axes)
+    var = jnp.var(x, axis=reduce_axes)  # biased — used for normalization
+
+    if gamma.ndim == 2:
+        s = jnp.minimum(step, gamma.shape[0] - 1)
+        gamma = gamma[s]
+        beta = beta[s]
+
+    inv = jax.lax.rsqrt(var + eps)
+    out = (x - mean[None, :, None, None]) * inv[None, :, None, None]
+    out = out * gamma[None, :, None, None] + beta[None, :, None, None]
+    out = out.astype(in_dtype)
+
+    # Running-stat update (unbiased variance, torch semantics).
+    var_unbiased = var * (n / max(n - 1, 1))
+    if per_step_state:
+        s = jnp.minimum(step, state.running_mean.shape[0] - 1)
+        new_mean_row = (1.0 - momentum) * state.running_mean[s] + momentum * mean
+        new_var_row = (1.0 - momentum) * state.running_var[s] + momentum * var_unbiased
+        new_state = BatchNormState(
+            running_mean=state.running_mean.at[s].set(new_mean_row),
+            running_var=state.running_var.at[s].set(new_var_row),
+        )
+    else:
+        new_state = BatchNormState(
+            running_mean=(1.0 - momentum) * state.running_mean + momentum * mean,
+            running_var=(1.0 - momentum) * state.running_var + momentum * var_unbiased,
+        )
+    return out, new_state
+
+
+def layer_norm(
+    x: jax.Array, weight: jax.Array, bias: jax.Array, *, eps: float = 1e-5
+) -> jax.Array:
+    """Layer norm over the trailing feature dims (the reference normalizes
+    over ``(C, H, W)``, ``meta_neural_network_architectures.py:314-315``).
+
+    ``weight`` is frozen at 1.0 in the reference (``:279``) — learnability is
+    decided by the optimizer mask, not here.
+    """
+    norm_dims = tuple(range(x.ndim - weight.ndim, x.ndim))
+    mean = jnp.mean(x, axis=norm_dims, keepdims=True)
+    var = jnp.var(x, axis=norm_dims, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out * weight + bias

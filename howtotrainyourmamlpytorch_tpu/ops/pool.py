@@ -1,0 +1,37 @@
+"""Pooling via XLA reduce_window.
+
+TPU-native equivalents of the reference's ``F.max_pool2d`` / ``F.avg_pool2d``
+(``meta_neural_network_architectures.py:602,606``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def max_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
+    """Max pooling over ``(N, C, H, W)``, VALID padding (torch floor mode)."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 1, window, window),
+        window_strides=(1, 1, stride, stride),
+        padding="VALID",
+    )
+
+
+def avg_pool2d(x: jax.Array, window: int, stride: int | None = None) -> jax.Array:
+    """Average pooling over ``(N, C, H, W)``, VALID padding."""
+    stride = window if stride is None else stride
+    summed = lax.reduce_window(
+        x,
+        jnp.array(0, x.dtype),
+        lax.add,
+        window_dimensions=(1, 1, window, window),
+        window_strides=(1, 1, stride, stride),
+        padding="VALID",
+    )
+    return summed / (window * window)

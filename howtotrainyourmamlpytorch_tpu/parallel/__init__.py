@@ -1,0 +1,31 @@
+"""Device-mesh parallelism: sharding specs, collectives, multi-host init.
+
+The reference's only parallel strategy is single-process ``nn.DataParallel``
+(replicate module, scatter meta-batch over GPUs, gather; ``few_shot_learning_
+system.py:73-81`` plus the manual replica-dim plumbing at ``:147,154-158,
+201-206``). The TPU-native replacement is SPMD over a ``jax.sharding.Mesh``:
+the task axis of the meta-batch is sharded over the mesh's ``dp`` axis, model
+parameters are optionally tensor-sharded over ``mp``, and XLA emits the
+outer-gradient all-reduce over ICI (multi-host over DCN via
+``jax.distributed.initialize``). No replica-dim bookkeeping survives.
+"""
+
+from .mesh import (
+    make_mesh,
+    batch_sharding,
+    replicated,
+    param_shardings,
+    DEFAULT_DATA_AXIS,
+    DEFAULT_MODEL_AXIS,
+)
+from .distributed import initialize_distributed
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "param_shardings",
+    "initialize_distributed",
+    "DEFAULT_DATA_AXIS",
+    "DEFAULT_MODEL_AXIS",
+]

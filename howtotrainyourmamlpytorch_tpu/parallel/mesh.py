@@ -1,0 +1,86 @@
+"""Mesh construction and sharding rules.
+
+Replaces ``nn.DataParallel``'s scatter/gather (``few_shot_learning_system.py:
+73-81``) with named shardings over a device mesh:
+
+* ``dp`` — the task (data) axis: each device adapts its own slice of the
+  meta-batch's tasks; outer gradients all-reduce over ICI.
+* ``mp`` — optional tensor axis: conv filters and the linear head's output
+  features are sharded so the backbone itself can span chips (not needed for
+  parity — the reference has no TP — but the mesh axis is first-class so the
+  same code scales, SURVEY §2 parallelism table).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_DATA_AXIS = "dp"
+DEFAULT_MODEL_AXIS = "mp"
+
+
+def make_mesh(
+    devices=None, data_parallel: int | None = None, model_parallel: int = 1
+) -> Mesh:
+    """Builds a ``(dp, mp)`` mesh over the given (default: all) devices."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if data_parallel is None:
+        data_parallel = n // model_parallel
+    assert data_parallel * model_parallel == n, (
+        f"{data_parallel} x {model_parallel} != {n} devices"
+    )
+    return Mesh(
+        devices.reshape(data_parallel, model_parallel),
+        (DEFAULT_DATA_AXIS, DEFAULT_MODEL_AXIS),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shards the leading (task) axis of batch arrays over ``dp``."""
+    return NamedSharding(mesh, P(DEFAULT_DATA_AXIS))
+
+
+def param_shardings(mesh: Mesh, params: Any, shard_model: bool = False) -> Any:
+    """Sharding tree for backbone parameters.
+
+    With ``shard_model`` the output-channel axis of conv filters and the
+    output-feature axis of the linear head go over ``mp`` (per-step BN
+    gamma/beta follow their feature axis); otherwise everything is
+    replicated.
+    """
+    if not shard_model:
+        return jax.tree.map(lambda _: replicated(mesh), params)
+
+    def spec(path: tuple[str, ...], leaf) -> NamedSharding:
+        if path[-2:] == ("conv", "weight"):
+            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS))
+        if path[-2:] == ("conv", "bias"):
+            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS))
+        if "norm" in path and leaf.ndim >= 1:
+            # BN gamma/beta: feature axis last ((F,) or per-step (S, F));
+            # layer-norm weight/bias: (C, H, W) with the channel axis FIRST —
+            # it must follow the conv's output-channel sharding.
+            ax = [None] * leaf.ndim
+            if path[-1] in ("gamma", "beta"):
+                ax[-1] = DEFAULT_MODEL_AXIS
+            else:
+                ax[0] = DEFAULT_MODEL_AXIS
+            return NamedSharding(mesh, P(*ax))
+        if path[-2:] == ("linear", "weight"):
+            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS, None))
+        if path[-2:] == ("linear", "bias"):
+            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS))
+        return replicated(mesh)
+
+    from ..models.backbone import _map_with_path
+
+    return _map_with_path(spec, params)

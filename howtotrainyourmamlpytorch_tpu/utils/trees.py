@@ -1,0 +1,43 @@
+"""Pytree partition/merge helpers for fast-weight handling.
+
+Replaces the reference's flat name->tensor dict plumbing
+(``few_shot_learning_system.py:105-161``) with structural pytree operations:
+the inner loop adapts a *subtree* of the parameters, selected by a boolean
+mask pytree, and merges it back for each forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+Tree = Any
+
+
+def partition(tree: Tree, mask: Tree) -> tuple[Tree, Tree]:
+    """Splits ``tree`` into ``(selected, rest)`` by a same-structure boolean
+    mask. Unselected positions are ``None`` in ``selected`` and vice versa
+    (``None`` subtrees are treated as empty by JAX, so both halves remain
+    valid pytrees)."""
+    selected = jax.tree.map(lambda m, x: x if m else None, mask, tree)
+    rest = jax.tree.map(lambda m, x: None if m else x, mask, tree)
+    return selected, rest
+
+
+def merge(*trees: Tree) -> Tree:
+    """Merges complementary trees produced by :func:`partition` (first
+    non-``None`` leaf wins at each position)."""
+
+    def pick(*leaves):
+        for leaf in leaves:
+            if leaf is not None:
+                return leaf
+        return None
+
+    return jax.tree.map(pick, *trees, is_leaf=lambda x: x is None)
+
+
+def tree_where(mask: Tree, on_true: Tree, on_false: Tree) -> Tree:
+    """Elementwise select between two same-structure trees by a mask tree."""
+    return jax.tree.map(lambda m, t, f: t if m else f, mask, on_true, on_false)

@@ -1,0 +1,96 @@
+"""Backbone structure/shape tests vs the reference architecture
+(meta_neural_network_architectures.py:542-684)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.models.backbone import BackboneConfig, VGGBackbone
+
+
+def make(cfg=None):
+    cfg = cfg or BackboneConfig()
+    return VGGBackbone(cfg)
+
+
+def test_omniglot_shapes_max_pooling():
+    """28x28 Omniglot, 4 stages, max pooling: spatial 28->14->7->3->1,
+    feature dim 64 (matches reference dummy-trace build)."""
+    cfg = BackboneConfig(per_step_bn_statistics=True, num_steps=5)
+    bb = make(cfg)
+    assert cfg.stage_spatial_shapes() == [(14, 14), (7, 7), (3, 3), (1, 1)]
+    assert cfg.feature_dim == 64
+    params, bn_state = bb.init(jax.random.key(0))
+    x = jnp.zeros((10, 1, 28, 28))
+    logits, new_bn = bb.apply(params, bn_state, x, 0)
+    assert logits.shape == (10, 5)
+    assert params["conv0"]["norm"]["gamma"].shape == (5, 64)
+    assert bn_state["conv0"].running_mean.shape == (5, 64)
+
+
+def test_imagenet_shapes_strided():
+    """84x84 Mini-ImageNet, 48 filters, strided convs + global avg pool
+    (reference :565-570,605-606)."""
+    cfg = BackboneConfig(
+        num_filters=48, max_pooling=False, image_channels=3,
+        image_height=84, image_width=84, per_step_bn_statistics=True,
+    )
+    bb = make(cfg)
+    assert cfg.feature_dim == 48
+    params, bn_state = bb.init(jax.random.key(0))
+    x = jnp.zeros((4, 3, 84, 84))
+    logits, _ = bb.apply(params, bn_state, x, 0)
+    assert logits.shape == (4, 5)
+
+
+def test_param_count_matches_reference_formula():
+    """4 conv stages (3x3, 64f) + per-step BN gamma/beta + linear head."""
+    cfg = BackboneConfig(per_step_bn_statistics=True, num_steps=5)
+    params, _ = make(cfg).init(jax.random.key(0))
+    count = sum(x.size for x in jax.tree.leaves(params))
+    conv = (64 * 1 * 9 + 64) + 3 * (64 * 64 * 9 + 64)
+    bn = 4 * 2 * 5 * 64
+    lin = 5 * 64 + 5
+    assert count == conv + bn + lin
+
+
+def test_inner_loop_mask_excludes_norm_params():
+    """Inner loop adapts conv/linear only unless
+    enable_inner_loop_optimizable_bn_params (few_shot_learning_system.py:105-120)."""
+    cfg = BackboneConfig(per_step_bn_statistics=True)
+    bb = make(cfg)
+    params, _ = bb.init(jax.random.key(0))
+    mask = bb.inner_loop_mask(params)
+    assert mask["conv0"]["conv"]["weight"] is True
+    assert mask["conv0"]["norm"]["gamma"] is False
+    assert mask["linear"]["weight"] is True
+
+    cfg2 = BackboneConfig(
+        per_step_bn_statistics=True, enable_inner_loop_optimizable_bn_params=True
+    )
+    bb2 = make(cfg2)
+    params2, _ = bb2.init(jax.random.key(0))
+    # gamma/beta revert to (F,) so they can be inner-adapted (ref :194-198)
+    assert params2["conv0"]["norm"]["gamma"].shape == (64,)
+    assert bb2.inner_loop_mask(params2)["conv0"]["norm"]["gamma"] is True
+
+
+def test_layer_norm_variant():
+    cfg = BackboneConfig(norm_layer="layer_norm")
+    bb = make(cfg)
+    params, bn_state = bb.init(jax.random.key(0))
+    assert bn_state == {}
+    assert params["conv0"]["norm"]["weight"].shape == (64, 28, 28)
+    x = jnp.zeros((2, 1, 28, 28))
+    logits, _ = bb.apply(params, bn_state, x, 0)
+    assert logits.shape == (2, 5)
+
+
+def test_xavier_init_statistics():
+    cfg = BackboneConfig()
+    params, _ = make(cfg).init(jax.random.key(42))
+    w = np.asarray(params["conv1"]["conv"]["weight"])
+    fan = 64 * 9 + 64 * 9
+    limit = np.sqrt(6.0 / fan)
+    assert np.abs(w).max() <= limit + 1e-6
+    assert np.asarray(params["conv0"]["conv"]["bias"]).sum() == 0.0

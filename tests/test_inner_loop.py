@@ -1,0 +1,88 @@
+"""Inner-loop optimizer and MSL schedule tests
+(inner_loop_optimizers.py, few_shot_learning_system.py:83-103)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.inner_loop import init_lslr, lslr_update, sgd_update
+from howtotrainyourmamlpytorch_tpu.models.maml import (
+    final_step_importance,
+    per_step_loss_importance,
+)
+from howtotrainyourmamlpytorch_tpu.utils.trees import merge, partition
+
+
+def test_sgd_update():
+    p = {"w": jnp.ones(3)}
+    g = {"w": jnp.full(3, 2.0)}
+    out = sgd_update(p, g, 0.1)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.8)
+
+
+def test_lslr_allocates_num_steps_plus_one():
+    """Parity with inner_loop_optimizers.py:90 (num_steps+1 rates)."""
+    adapt = {"a": jnp.zeros((2, 2)), "b": jnp.zeros(3)}
+    lslr = init_lslr(adapt, num_steps=5, init_learning_rate=0.1)
+    assert lslr["a"].shape == (6,)
+    np.testing.assert_allclose(np.asarray(lslr["b"]), 0.1)
+
+
+def test_lslr_update_indexes_per_step():
+    adapt = {"a": jnp.ones(2)}
+    lslr = {"a": jnp.asarray([0.1, 0.5, 0.0])}
+    g = {"a": jnp.ones(2)}
+    out0 = lslr_update(adapt, g, lslr, 0)
+    out1 = lslr_update(adapt, g, lslr, 1)
+    np.testing.assert_allclose(np.asarray(out0["a"]), 0.9)
+    np.testing.assert_allclose(np.asarray(out1["a"]), 0.5)
+
+
+def test_lslr_gradient_flows_to_learning_rate():
+    """LSLR rates receive outer gradients even first-order (the update
+    w - lr*g is differentiable in lr)."""
+    adapt = {"a": jnp.ones(())}
+    lslr = {"a": jnp.asarray([0.1, 0.1])}
+
+    def loss(lslr_):
+        g = {"a": jnp.asarray(2.0)}
+        new = lslr_update(adapt, g, lslr_, 0)
+        return new["a"] ** 2
+
+    grad = jax.grad(loss)(lslr)
+    assert float(grad["a"][0]) != 0.0
+    assert float(grad["a"][1]) == 0.0
+
+
+def test_partition_merge_roundtrip():
+    tree = {"x": {"w": jnp.ones(2), "norm": jnp.zeros(2)}}
+    mask = {"x": {"w": True, "norm": False}}
+    sel, rest = partition(tree, mask)
+    assert rest["x"]["w"] is None and sel["x"]["norm"] is None
+    merged = merge(sel, rest)
+    np.testing.assert_allclose(np.asarray(merged["x"]["w"]), 1.0)
+    np.testing.assert_allclose(np.asarray(merged["x"]["norm"]), 0.0)
+
+
+def test_msl_importance_matches_reference_math():
+    """Exact replication of get_per_step_loss_importance_vector
+    (few_shot_learning_system.py:83-103)."""
+    for epoch in [0, 3, 9, 15, 50]:
+        n, msl_epochs = 5, 10
+        ours = per_step_loss_importance(epoch, n, msl_epochs)
+        # reference math, independently recomputed
+        w = np.ones(n) * (1.0 / n)
+        decay = 1.0 / n / msl_epochs
+        min_nf = 0.03 / n
+        for i in range(n - 1):
+            w[i] = max(w[i] - epoch * decay, min_nf)
+        w[-1] = min(w[-1] + epoch * (n - 1) * decay, 1.0 - (n - 1) * min_nf)
+        np.testing.assert_allclose(ours, w, atol=1e-7)
+        np.testing.assert_allclose(ours.sum(), 1.0, atol=1e-5)
+
+
+def test_msl_importance_converges_to_final_step():
+    v = per_step_loss_importance(9, 5, 10)
+    assert v[-1] > 0.9
+    one_hot = final_step_importance(5)
+    np.testing.assert_allclose(one_hot, [0, 0, 0, 0, 1.0])

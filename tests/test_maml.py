@@ -1,0 +1,188 @@
+"""MAML/MAML++ system tests: inner-loop semantics, gradient order,
+finite-difference checks, trainer contract (few_shot_learning_system.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models.backbone import BackboneConfig
+from howtotrainyourmamlpytorch_tpu.models.maml import (
+    MAMLConfig,
+    MAMLFewShotLearner,
+    final_step_importance,
+)
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=8,
+            image_height=14,
+            image_width=14,
+            num_classes=3,
+            per_step_bn_statistics=True,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_iter_per_epoch=4,
+        total_epochs=3,
+        remat_inner_steps=True,
+    )
+    defaults.update(kw)
+    return MAMLConfig(**defaults)
+
+
+def tiny_batch(rng, b=2, n=3, k=2, t=2, c=1, h=14, w=14):
+    xs = rng.randn(b, n, k, c, h, w).astype(np.float32)
+    xt = rng.randn(b, n, t, c, h, w).astype(np.float32)
+    ys = np.tile(np.arange(n)[None, :, None], (b, 1, k)).astype(np.float32)
+    yt = np.tile(np.arange(n)[None, :, None], (b, 1, t)).astype(np.float32)
+    return xs, xt, ys, yt
+
+
+def test_train_iter_runs_and_decreases_loss(rng):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    batch = tiny_batch(rng)
+    losses = []
+    for i in range(8):
+        state, metrics = learner.run_train_iter(state, batch, epoch=0)
+        losses.append(metrics["loss"])
+    assert losses[-1] < losses[0], losses
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+    assert "loss_importance_vector_0" in metrics
+    # LR is pinned to the PASSED epoch (scheduler.step(epoch) semantics)
+    assert metrics["learning_rate"] == pytest.approx(0.001)
+
+
+def test_validation_iter_is_pure(rng):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    batch = tiny_batch(rng)
+    flat_before = jax.tree.leaves(state)
+    state2, losses, preds = learner.run_validation_iter(state, batch)
+    for a, b in zip(flat_before, jax.tree.leaves(state2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert preds.shape == (2, 6, 3)  # (tasks, N*T, classes)
+
+
+def test_first_vs_second_order_gradients_differ(rng):
+    """create_graph=use_second_order (few_shot_learning_system.py:138-139):
+    the orders must produce different outer gradients."""
+    cfg = tiny_cfg()
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.key(1))
+    batch_np = tiny_batch(rng)
+    batch = learner._prepare_batch(batch_np)
+    importance = final_step_importance(2)
+
+    def outer_grads(second_order):
+        outer = {"theta": state.theta, "lslr": state.lslr}
+        g, _ = jax.grad(learner._meta_loss, has_aux=True)(
+            outer, state.bn_state, batch, jnp.asarray(importance), 2, second_order
+        )
+        return g
+
+    g_fo = outer_grads(False)
+    g_so = outer_grads(True)
+    w_fo = np.asarray(g_fo["theta"]["conv0"]["conv"]["weight"])
+    w_so = np.asarray(g_so["theta"]["conv0"]["conv"]["weight"])
+    assert not np.allclose(w_fo, w_so, atol=1e-6)
+
+
+def test_second_order_gradient_finite_difference(rng):
+    """The outer gradient of the adapted target loss w.r.t. a parameter must
+    match a central finite difference through the full inner loop."""
+    cfg = tiny_cfg()
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.key(2))
+    batch = learner._prepare_batch(tiny_batch(rng, b=1))
+    importance = jnp.asarray(final_step_importance(2))
+
+    def loss_for(theta):
+        outer = {"theta": theta, "lslr": state.lslr}
+        loss, _ = learner._meta_loss(
+            outer, state.bn_state, batch, importance, 2, True
+        )
+        return loss
+
+    g = jax.grad(loss_for)(state.theta)
+    # probe one scalar: linear bias[0]
+    eps = 1e-3
+
+    def perturb(delta):
+        theta = jax.tree.map(lambda x: x, state.theta)
+        theta["linear"]["bias"] = theta["linear"]["bias"].at[0].add(delta)
+        return float(loss_for(theta))
+
+    fd = (perturb(eps) - perturb(-eps)) / (2 * eps)
+    analytic = float(g["linear"]["bias"][0])
+    assert analytic == pytest.approx(fd, rel=0.05, abs=1e-4)
+
+
+def test_lslr_gets_outer_updates_only_when_learnable(rng):
+    batch = tiny_batch(rng)
+    for learnable in [True, False]:
+        learner = MAMLFewShotLearner(
+            tiny_cfg(learnable_per_layer_per_step_inner_loop_learning_rate=learnable)
+        )
+        state = learner.init_state(jax.random.key(0))
+        lslr_before = np.asarray(state.lslr["linear"]["weight"])
+        state, _ = learner.run_train_iter(state, batch, epoch=0)
+        lslr_after = np.asarray(state.lslr["linear"]["weight"])
+        changed = not np.allclose(lslr_before, lslr_after)
+        assert changed == learnable
+
+
+def test_bn_gamma_frozen_when_not_learnable(rng):
+    batch = tiny_batch(rng)
+    learner = MAMLFewShotLearner(tiny_cfg(learnable_bn_gamma=False))
+    state = learner.init_state(jax.random.key(0))
+    gamma_before = np.asarray(state.theta["conv0"]["norm"]["gamma"])
+    beta_before = np.asarray(state.theta["conv0"]["norm"]["beta"])
+    state, _ = learner.run_train_iter(state, batch, epoch=0)
+    np.testing.assert_array_equal(
+        gamma_before, np.asarray(state.theta["conv0"]["norm"]["gamma"])
+    )
+    assert not np.allclose(beta_before, np.asarray(state.theta["conv0"]["norm"]["beta"]))
+
+
+def test_derivative_order_annealing(rng):
+    """second_order and epoch > first_order_to_second_order_epoch
+    (few_shot_learning_system.py:304-305)."""
+    learner = MAMLFewShotLearner(tiny_cfg(first_order_to_second_order_epoch=1))
+    assert not learner._use_second_order(0)
+    assert not learner._use_second_order(1)
+    assert learner._use_second_order(2)
+
+
+def test_bn_state_updates_during_training(rng):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    rm_before = np.asarray(state.bn_state["conv0"].running_mean)
+    state, _ = learner.run_train_iter(state, tiny_batch(rng), epoch=0)
+    rm_after = np.asarray(state.bn_state["conv0"].running_mean)
+    assert not np.allclose(rm_before, rm_after)
+    # only rows 0..num_steps-1 written (per-step indexing)
+    assert rm_after.shape == (2, 8)
+
+
+def test_cosine_lr_schedule_by_epoch():
+    """torch CosineAnnealingLR closed form, driven by the passed epoch
+    (few_shot_learning_system.py:70-71,346)."""
+    cfg = tiny_cfg(meta_learning_rate=0.001, min_learning_rate=1e-5,
+                   total_epochs=10, total_iter_per_epoch=100)
+    learner = MAMLFewShotLearner(cfg)
+    assert learner._epoch_lr(0) == pytest.approx(0.001)
+    assert learner._epoch_lr(5) == pytest.approx((0.001 + 1e-5) / 2, rel=1e-3)
+    assert learner._epoch_lr(10) == pytest.approx(1e-5, rel=1e-3)
+
+
+def test_config_validates_bn_rows_vs_inner_steps():
+    """Mismatched per-step BN rows vs inner steps must be rejected, not
+    silently clamped."""
+    with pytest.raises(ValueError, match="num_steps"):
+        tiny_cfg(number_of_training_steps_per_iter=7)

@@ -1,0 +1,135 @@
+"""Op-level parity tests against torch.nn.functional (CPU torch is the
+ground truth for the reference's numerical semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from howtotrainyourmamlpytorch_tpu.ops import (
+    accuracy,
+    avg_pool2d,
+    conv2d,
+    cross_entropy,
+    linear,
+    max_pool2d,
+)
+from howtotrainyourmamlpytorch_tpu.ops.norm import (
+    batch_norm,
+    init_batch_norm_state,
+    layer_norm,
+)
+
+
+def test_conv2d_matches_torch(rng):
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(5, 3, 3, 3).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    ours = conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), stride=1, padding=1)
+    theirs = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+                      stride=1, padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4)
+
+
+def test_conv2d_stride2_no_padding(rng):
+    x = rng.randn(2, 1, 28, 28).astype(np.float32)
+    w = rng.randn(4, 1, 3, 3).astype(np.float32)
+    ours = conv2d(jnp.asarray(x), jnp.asarray(w), None, stride=2, padding=0)
+    theirs = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), stride=2).numpy()
+    assert ours.shape == theirs.shape
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4)
+
+
+def test_linear_matches_torch(rng):
+    x = rng.randn(4, 16).astype(np.float32)
+    w = rng.randn(5, 16).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    ours = linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    theirs = F.linear(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+
+
+def test_batch_norm_matches_torch_training_mode(rng):
+    """The reference always runs F.batch_norm(training=True)
+    (meta_neural_network_architectures.py:246-247)."""
+    x = rng.randn(6, 4, 5, 5).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    state = init_batch_norm_state(4)
+    out, new_state = batch_norm(
+        jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta), state, 0
+    )
+    rm = torch.zeros(4)
+    rv = torch.ones(4)
+    theirs = F.batch_norm(
+        torch.from_numpy(x), rm, rv, torch.from_numpy(gamma), torch.from_numpy(beta),
+        training=True, momentum=0.1, eps=1e-5,
+    ).numpy()
+    np.testing.assert_allclose(np.asarray(out), theirs, atol=1e-4)
+    # Running stats updated with torch semantics (unbiased var).
+    np.testing.assert_allclose(np.asarray(new_state.running_mean), rm.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state.running_var), rv.numpy(), atol=1e-4)
+
+
+def test_batch_norm_per_step_rows(rng):
+    """Per-step gamma/beta/statistics are indexed by the inner step
+    (meta_neural_network_architectures.py:226-234); only the indexed row of
+    the running stats is written."""
+    x = rng.randn(6, 4, 5, 5).astype(np.float32)
+    gamma = np.stack([np.full(4, 1.0), np.full(4, 2.0)]).astype(np.float32)
+    beta = np.zeros((2, 4), np.float32)
+    state = init_batch_norm_state(4, num_steps=2)
+    out0, st0 = batch_norm(jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta), state, 0)
+    out1, st1 = batch_norm(jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta), state, 1)
+    np.testing.assert_allclose(np.asarray(out1), 2.0 * np.asarray(out0), atol=1e-4)
+    # step 0 writes row 0 only; row 1 untouched
+    assert not np.allclose(np.asarray(st0.running_mean[0]), 0.0)
+    np.testing.assert_allclose(np.asarray(st0.running_mean[1]), 0.0)
+    np.testing.assert_allclose(np.asarray(st1.running_mean[0]), 0.0)
+    # out-of-range step clamps to last row
+    out_clamped, _ = batch_norm(
+        jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta), state, 7
+    )
+    np.testing.assert_allclose(np.asarray(out_clamped), np.asarray(out1), atol=1e-6)
+
+
+def test_layer_norm_matches_torch(rng):
+    x = rng.randn(3, 4, 5, 5).astype(np.float32)
+    w = np.ones((4, 5, 5), np.float32)
+    b = rng.randn(4, 5, 5).astype(np.float32)
+    ours = layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    theirs = F.layer_norm(
+        torch.from_numpy(x), (4, 5, 5), torch.from_numpy(w), torch.from_numpy(b)
+    ).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4)
+
+
+def test_max_pool_matches_torch(rng):
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)  # odd size: floor mode
+    ours = max_pool2d(jnp.asarray(x), 2, 2)
+    theirs = F.max_pool2d(torch.from_numpy(x), 2, 2).numpy()
+    assert ours.shape == theirs.shape == (2, 3, 3, 3)
+    np.testing.assert_allclose(np.asarray(ours), theirs)
+
+
+def test_avg_pool_matches_torch(rng):
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    ours = avg_pool2d(jnp.asarray(x), 6)
+    theirs = F.avg_pool2d(torch.from_numpy(x), 6).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+
+
+def test_cross_entropy_matches_torch(rng):
+    logits = rng.randn(10, 5).astype(np.float32)
+    labels = rng.randint(0, 5, 10)
+    ours = cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    theirs = F.cross_entropy(torch.from_numpy(logits), torch.from_numpy(labels)).item()
+    np.testing.assert_allclose(float(ours), theirs, atol=1e-5)
+
+
+def test_accuracy():
+    logits = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]])
+    labels = jnp.asarray([0, 1, 1])
+    assert float(accuracy(logits, labels)) == pytest.approx(2 / 3)
