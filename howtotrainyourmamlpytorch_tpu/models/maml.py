@@ -110,6 +110,21 @@ class MAMLConfig:
                 f" ({self.number_of_training_steps_per_iter}) when"
                 " per_step_bn_statistics is on"
             )
+        # The LSLR table has number_of_training_steps_per_iter + 1 rows
+        # (inner_loop.py); evaluating with more steps than that would
+        # silently clamp to the never-trained final row. The reference would
+        # IndexError on the same config — refuse it explicitly.
+        if (
+            self.number_of_evaluation_steps_per_iter
+            > self.number_of_training_steps_per_iter + 1
+        ):
+            raise ValueError(
+                "number_of_evaluation_steps_per_iter"
+                f" ({self.number_of_evaluation_steps_per_iter}) may exceed"
+                " number_of_training_steps_per_iter"
+                f" ({self.number_of_training_steps_per_iter}) by at most 1"
+                " (the LSLR table has training_steps + 1 rows)"
+            )
 
 
 def per_step_loss_importance(
@@ -168,30 +183,46 @@ class MAMLFewShotLearner:
         self.mesh = mesh
         self.current_epoch = 0
 
-        jit_kwargs = {}
+        self._jit_kwargs = {}
         if mesh is not None:
             from ..parallel.mesh import batch_sharding, replicated
 
             # State and importance replicated; the task axis of every batch
             # array sharded over the mesh's data axis ('dp'). XLA inserts the
             # outer-grad all-reduce over ICI automatically.
-            jit_kwargs["in_shardings"] = (
+            self._jit_kwargs["in_shardings"] = (
                 replicated(mesh),
                 batch_sharding(mesh),
                 replicated(mesh),
             )
 
-        self._train_step_so = jax.jit(
-            functools.partial(self._train_step, second_order=True),
-            donate_argnums=(0,),
-            **jit_kwargs,
-        )
-        self._train_step_fo = jax.jit(
-            functools.partial(self._train_step, second_order=False),
-            donate_argnums=(0,),
-            **jit_kwargs,
-        )
-        self._eval_step = jax.jit(self._evaluation_step, **jit_kwargs)
+        # Compiled step variants, keyed by the static flags
+        # (second_order, final_only); built lazily so a run only compiles
+        # the variants its epochs actually reach.
+        self._train_steps: dict[tuple[bool, bool], Any] = {}
+        self._eval_steps: dict[bool, Any] = {}
+
+    def _get_train_step(self, second_order: bool, final_only: bool):
+        key = (second_order, final_only)
+        if key not in self._train_steps:
+            self._train_steps[key] = jax.jit(
+                functools.partial(
+                    self._train_step,
+                    second_order=second_order,
+                    final_only=final_only,
+                ),
+                donate_argnums=(0,),
+                **self._jit_kwargs,
+            )
+        return self._train_steps[key]
+
+    def _get_eval_step(self, final_only: bool):
+        if final_only not in self._eval_steps:
+            self._eval_steps[final_only] = jax.jit(
+                functools.partial(self._evaluation_step, final_only=final_only),
+                **self._jit_kwargs,
+            )
+        return self._eval_steps[final_only]
 
     # ------------------------------------------------------------------
     # Initialization
@@ -291,11 +322,19 @@ class MAMLFewShotLearner:
         num_steps: int,
         second_order: bool,
         pred_step: int | None = None,
+        final_only: bool = False,
     ):
         """Inner-loop adaptation + per-step target losses for ONE task.
 
         Returns ``(weighted_loss, aux)`` where aux carries the final-step
         target logits, accuracy, and the evolved BN state.
+
+        With ``final_only`` (static) the per-step target forwards are
+        omitted and a single target pass runs after the scan — the loss the
+        reference computes once MSL is off or past its epoch horizon
+        (``few_shot_learning_system.py:239-244``); ``importance`` is ignored
+        (it would be one-hot on the final step). This halves the forward
+        work and its second-order backward per inner step.
         """
         backbone = self.backbone
         mask = backbone.inner_loop_mask(theta)
@@ -303,6 +342,8 @@ class MAMLFewShotLearner:
         compute_dtype = self.cfg.dtype
         x_support = x_support.astype(compute_dtype)
         x_target = x_target.astype(compute_dtype)
+        if final_only:
+            assert pred_step is None or pred_step == num_steps - 1
 
         def step_fn(carry, step):
             fast, bn = carry
@@ -317,6 +358,8 @@ class MAMLFewShotLearner:
             if not second_order:
                 grads = lax.stop_gradient(grads)
             fast = lslr_update(fast, grads, lslr, step)
+            if final_only:
+                return (fast, bn1), s_loss
             t_logits, bn2 = backbone.apply(merge(fast, frozen), bn1, x_target, step)
             t_loss = cross_entropy(t_logits, y_target)
             return (fast, bn2), (s_loss, t_loss, t_logits)
@@ -324,17 +367,29 @@ class MAMLFewShotLearner:
         if self.cfg.remat_inner_steps:
             step_fn = jax.checkpoint(step_fn)
 
-        (fast_final, bn_final), (s_losses, t_losses, t_logits) = lax.scan(
-            step_fn, (adapt0, bn_state), jnp.arange(num_steps)
-        )
-        del fast_final
-        weighted = jnp.sum(importance * t_losses)
-        # Predictions/accuracy come from the same step whose target loss is
-        # reported: the final step in training; at eval, the reference's
-        # final-loss condition fires at the *training* step count
-        # (few_shot_learning_system.py:239), so pred_step may differ.
-        pred_step = num_steps - 1 if pred_step is None else pred_step
-        final_logits = t_logits[pred_step].astype(jnp.float32)
+        if final_only:
+            (fast_final, bn_final), s_losses = lax.scan(
+                step_fn, (adapt0, bn_state), jnp.arange(num_steps)
+            )
+            t_logits, bn_final = backbone.apply(
+                merge(fast_final, frozen), bn_final, x_target, num_steps - 1
+            )
+            weighted = cross_entropy(t_logits, y_target)
+            t_losses = weighted[None]
+            final_logits = t_logits.astype(jnp.float32)
+        else:
+            (fast_final, bn_final), (s_losses, t_losses, t_logits) = lax.scan(
+                step_fn, (adapt0, bn_state), jnp.arange(num_steps)
+            )
+            del fast_final
+            weighted = jnp.sum(importance * t_losses)
+            # Predictions/accuracy come from the same step whose target loss
+            # is reported: the final step in training; at eval, the
+            # reference's final-loss condition fires at the *training* step
+            # count (few_shot_learning_system.py:239), so pred_step may
+            # differ.
+            pred_step = num_steps - 1 if pred_step is None else pred_step
+            final_logits = t_logits[pred_step].astype(jnp.float32)
         acc = accuracy(final_logits, y_target)
         return weighted, dict(
             logits=final_logits,
@@ -357,6 +412,7 @@ class MAMLFewShotLearner:
         num_steps,
         second_order,
         pred_step: int | None = None,
+        final_only: bool = False,
     ):
         xs, xt, ys, yt = batch  # (B, N*K, C, H, W), ..., (B, N*K), (B, N*T)
         per_task = functools.partial(
@@ -364,6 +420,7 @@ class MAMLFewShotLearner:
             num_steps=num_steps,
             second_order=second_order,
             pred_step=pred_step,
+            final_only=final_only,
         )
         weighted, aux = jax.vmap(
             per_task, in_axes=(None, None, None, 0, 0, 0, 0, None)
@@ -371,11 +428,14 @@ class MAMLFewShotLearner:
         # Mean over tasks (few_shot_learning_system.py:164)
         return jnp.mean(weighted), aux
 
-    def _train_step(self, state: TrainState, batch, importance, *, second_order):
+    def _train_step(
+        self, state: TrainState, batch, importance, *, second_order, final_only=False
+    ):
         outer = {"theta": state.theta, "lslr": state.lslr}
         (loss, aux), grads = jax.value_and_grad(self._meta_loss, has_aux=True)(
             outer, state.bn_state, batch, importance,
             self.cfg.number_of_training_steps_per_iter, second_order,
+            None, final_only,
         )
         updates, opt_state = self.tx.update(grads, state.opt_state, outer)
         outer = optax.apply_updates(outer, updates)
@@ -393,7 +453,7 @@ class MAMLFewShotLearner:
         metrics = dict(loss=loss, accuracy=jnp.mean(aux["accuracy"]))
         return new_state, metrics
 
-    def _evaluation_step(self, state: TrainState, batch, importance):
+    def _evaluation_step(self, state: TrainState, batch, importance, *, final_only=False):
         """Adaptation + final-step target evaluation; BN state is discarded
         (the functional form of the reference's backup/restore,
         ``few_shot_learning_system.py:254-255``). Always first order
@@ -409,7 +469,8 @@ class MAMLFewShotLearner:
         )
         loss, aux = self._meta_loss(
             outer, state.bn_state, batch, importance,
-            cfg.number_of_evaluation_steps_per_iter, False, pred_step,
+            cfg.number_of_evaluation_steps_per_iter, False,
+            None if final_only else pred_step, final_only,
         )
         metrics = dict(loss=loss, accuracy=jnp.mean(aux["accuracy"]))
         return metrics, aux["logits"]
@@ -448,9 +509,13 @@ class MAMLFewShotLearner:
         importance = self._train_importance(epoch)
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
-        step_fn = (
-            self._train_step_so if self._use_second_order(epoch) else self._train_step_fo
+        # Past the MSL horizon the importance vector is one-hot on the final
+        # step — use the compiled variant that skips per-step target passes.
+        final_only = not (
+            self.cfg.use_multi_step_loss_optimization
+            and epoch < self.cfg.multi_step_loss_num_epochs
         )
+        step_fn = self._get_train_step(self._use_second_order(epoch), final_only)
         new_state, metrics = step_fn(state, batch, importance)
         losses = {
             "loss": float(metrics["loss"]),
@@ -471,7 +536,19 @@ class MAMLFewShotLearner:
         per_task_preds)``; state is returned unchanged (pure eval — the
         functional form of the reference's BN backup/restore)."""
         batch = self._prepare_batch(data_batch)
-        metrics, logits = self._eval_step(state, batch, self._eval_importance())
+        cfg = self.cfg
+        # The eval target loss sits at the *training* final-step index
+        # (few_shot_learning_system.py:239); when that coincides with the
+        # last eval step (the usual config) the final-only variant applies.
+        final_only = (
+            min(
+                cfg.number_of_training_steps_per_iter,
+                cfg.number_of_evaluation_steps_per_iter,
+            )
+            == cfg.number_of_evaluation_steps_per_iter
+        )
+        eval_fn = self._get_eval_step(final_only)
+        metrics, logits = eval_fn(state, batch, self._eval_importance())
         losses = {
             "loss": float(metrics["loss"]),
             "accuracy": float(metrics["accuracy"]),

@@ -20,12 +20,33 @@ def initialize_distributed(
 ) -> None:
     """Initializes JAX's distributed runtime when running multi-host.
 
-    No-op in single-process runs (the common case on one chip/host). Args
-    default from the standard JAX env vars / cluster auto-detection.
+    Calls ``jax.distributed.initialize`` (which includes cluster
+    auto-detection for Cloud TPU / GKE / Slurm) whenever any multi-host
+    signal is present: explicit args, ``JAX_NUM_PROCESSES`` /
+    ``JAX_COORDINATOR_ADDRESS`` env vars, or a detectable cluster
+    environment. Only a positively single-process run (no signal at all)
+    no-ops, so plain single-chip usage never blocks on coordination.
     """
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
-    if num_processes is None or num_processes <= 1:
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+
+    explicit = coordinator_address is not None or (
+        num_processes is not None and num_processes > 1
+    )
+    if not explicit:
+        try:  # private JAX registry; treat any failure as "no cluster"
+            from jax._src.clusters import ClusterEnv
+
+            detected = any(
+                env.is_env_present() for env in ClusterEnv._cluster_types
+            )
+        except Exception:
+            detected = False
+        if not detected:
+            return  # positively single-process
+    if num_processes is not None and num_processes <= 1 and not explicit:
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

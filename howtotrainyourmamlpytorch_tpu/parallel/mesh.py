@@ -5,10 +5,11 @@ Replaces ``nn.DataParallel``'s scatter/gather (``few_shot_learning_system.py:
 
 * ``dp`` — the task (data) axis: each device adapts its own slice of the
   meta-batch's tasks; outer gradients all-reduce over ICI.
-* ``mp`` — optional tensor axis: conv filters and the linear head's output
-  features are sharded so the backbone itself can span chips (not needed for
-  parity — the reference has no TP — but the mesh axis is first-class so the
-  same code scales, SURVEY §2 parallelism table).
+* ``mp`` — optional tensor axis: conv filters are sharded over output
+  channels and the linear head row-parallel over its input features, so the
+  backbone itself can span chips (not needed for parity — the reference has
+  no TP — but the mesh axis is first-class so the same code scales, SURVEY
+  §2 parallelism table).
 """
 
 from __future__ import annotations
@@ -52,19 +53,30 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 def param_shardings(mesh: Mesh, params: Any, shard_model: bool = False) -> Any:
     """Sharding tree for backbone parameters.
 
-    With ``shard_model`` the output-channel axis of conv filters and the
-    output-feature axis of the linear head go over ``mp`` (per-step BN
-    gamma/beta follow their feature axis); otherwise everything is
-    replicated.
+    With ``shard_model`` the output-channel axis of conv filters goes over
+    ``mp`` (per-step BN gamma/beta follow their feature axis) and the linear
+    head is row-parallel: its input-feature axis is sharded, its bias
+    replicated, with XLA inserting the psum over partial products. Axes not
+    divisible by the ``mp`` size fall back to replication. Otherwise
+    everything is replicated.
     """
     if not shard_model:
         return jax.tree.map(lambda _: replicated(mesh), params)
 
+    mp = mesh.shape[DEFAULT_MODEL_AXIS]
+
+    def guarded(leaf, ax: list) -> NamedSharding:
+        """Replicate instead of sharding an axis not divisible by |mp|."""
+        for i, name in enumerate(ax):
+            if name is not None and leaf.shape[i] % mp != 0:
+                ax[i] = None
+        return NamedSharding(mesh, P(*ax))
+
     def spec(path: tuple[str, ...], leaf) -> NamedSharding:
         if path[-2:] == ("conv", "weight"):
-            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS))
+            return guarded(leaf, [DEFAULT_MODEL_AXIS, None, None, None])
         if path[-2:] == ("conv", "bias"):
-            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS))
+            return guarded(leaf, [DEFAULT_MODEL_AXIS])
         if "norm" in path and leaf.ndim >= 1:
             # BN gamma/beta: feature axis last ((F,) or per-step (S, F));
             # layer-norm weight/bias: (C, H, W) with the channel axis FIRST —
@@ -74,11 +86,14 @@ def param_shardings(mesh: Mesh, params: Any, shard_model: bool = False) -> Any:
                 ax[-1] = DEFAULT_MODEL_AXIS
             else:
                 ax[0] = DEFAULT_MODEL_AXIS
-            return NamedSharding(mesh, P(*ax))
+            return guarded(leaf, ax)
         if path[-2:] == ("linear", "weight"):
-            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS, None))
+            # Row-parallel: shard the input-feature axis ((num_classes, feat)
+            # layout) — the class axis is tiny (e.g. 5), features are wide;
+            # XLA inserts the psum over partial products.
+            return guarded(leaf, [None, DEFAULT_MODEL_AXIS])
         if path[-2:] == ("linear", "bias"):
-            return NamedSharding(mesh, P(DEFAULT_MODEL_AXIS))
+            return replicated(mesh)
         return replicated(mesh)
 
     from ..models.backbone import _map_with_path

@@ -36,8 +36,3 @@ def merge(*trees: Tree) -> Tree:
         return None
 
     return jax.tree.map(pick, *trees, is_leaf=lambda x: x is None)
-
-
-def tree_where(mask: Tree, on_true: Tree, on_false: Tree) -> Tree:
-    """Elementwise select between two same-structure trees by a mask tree."""
-    return jax.tree.map(lambda m, t, f: t if m else f, mask, on_true, on_false)
