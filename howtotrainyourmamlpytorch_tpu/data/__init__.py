@@ -1,0 +1,20 @@
+"""Host-side episodic data pipeline (dataset-agnostic N-way K-shot tasks).
+
+TPU-native replacement for the reference's torch ``Dataset``/``DataLoader``
+pipeline (``data.py``): pure NumPy/PIL episode synthesis with the reference's
+exact deterministic seed math, a thread-pool episode loader with background
+batch prefetch (the role torch's worker processes play in the reference),
+and per-dataset augmentation tables.
+"""
+
+from .augment import augment_image, get_transforms_for_dataset, rotate_image
+from .dataset import FewShotLearningDataset
+from .loader import MetaLearningSystemDataLoader
+
+__all__ = [
+    "FewShotLearningDataset",
+    "MetaLearningSystemDataLoader",
+    "augment_image",
+    "get_transforms_for_dataset",
+    "rotate_image",
+]

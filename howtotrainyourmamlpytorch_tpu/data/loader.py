@@ -1,0 +1,130 @@
+"""Meta-learning batch loader: parallel episode synthesis + prefetch.
+
+Capability parity with the reference's ``MetaLearningSystemDataLoader``
+(``data.py:555-636``), which wraps the dataset in a torch ``DataLoader``
+(worker processes, ``batch_size = num_gpus * batch_size * samples_per_iter``,
+``shuffle=False``, ``drop_last=True``). TPU-native redesign:
+
+* episodes are synthesized by a thread pool (PIL decode and NumPy transforms
+  release the GIL — the role of torch's worker processes) and collated into
+  ``(B, N, K/T, C, H, W)`` NumPy batches;
+* a bounded background prefetch queue keeps episode synthesis ahead of the
+  device step, so host data work overlaps TPU compute (the reference relies
+  on DataLoader prefetching for the same purpose);
+* determinism and resume semantics are identical: batch ``i`` of epoch ``e``
+  draws episodes from seeds ``init_seed + total_train_iters_produced + idx``
+  and ``continue_from_iter`` fast-forwards that offset
+  (``data.py:536-542,583-588``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+
+import numpy as np
+
+from .dataset import FewShotLearningDataset
+
+
+class MetaLearningSystemDataLoader:
+    """Train/val/test episode-batch generators over the episode dataset."""
+
+    def __init__(self, args, current_iter: int = 0):
+        self.args = args
+        self.num_of_gpus = args.num_of_gpus
+        self.batch_size = args.batch_size
+        self.samples_per_iter = args.samples_per_iter
+        self.num_workers = max(int(args.num_dataprovider_workers), 1)
+        self.total_train_iters_produced = 0
+        self.dataset = FewShotLearningDataset(args=args)
+        self.batches_per_iter = args.samples_per_iter
+        self.full_data_length = dict(self.dataset.data_length)
+        self.continue_from_iter(current_iter=current_iter)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.num_workers
+        )
+
+    @property
+    def global_batch(self) -> int:
+        """Episodes consumed per yielded batch (``data.py:575-581``)."""
+        return self.num_of_gpus * self.batch_size * self.samples_per_iter
+
+    def continue_from_iter(self, current_iter: int) -> None:
+        """Fast-forwards the train seed offset after resume (``data.py:
+        583-588``)."""
+        self.total_train_iters_produced += current_iter * self.global_batch
+
+    # ------------------------------------------------------------------
+    # Batch generation
+    # ------------------------------------------------------------------
+
+    def _collate(self, episodes):
+        """Stacks per-episode tuples into batch arrays."""
+        xs, xt, ys, yt, seeds = zip(*episodes)
+        return (
+            np.stack(xs),
+            np.stack(xt),
+            np.stack(ys),
+            np.stack(yt),
+            np.asarray(seeds),
+        )
+
+    def _iter_batches(self, length: int, prefetch: int = 2):
+        """Yields collated batches of ``global_batch`` episodes, synthesized
+        by the thread pool and prefetched ``prefetch`` batches ahead.
+        ``drop_last=True`` like the reference."""
+        n_batches = length // self.global_batch
+        out: queue.Queue = queue.Queue(maxsize=prefetch)
+        sentinel = object()
+
+        def produce():
+            for b in range(n_batches):
+                idxs = range(b * self.global_batch, (b + 1) * self.global_batch)
+                episodes = list(self._pool.map(self.dataset.__getitem__, idxs))
+                out.put(self._collate(episodes))
+            out.put(sentinel)
+
+        thread = threading.Thread(target=produce, daemon=True)
+        thread.start()
+        while True:
+            batch = out.get()
+            if batch is sentinel:
+                break
+            yield batch
+        thread.join()
+
+    def get_train_batches(self, total_batches: int = -1, augment_images: bool = False):
+        """Training batches, advancing the deterministic seed window
+        (``data.py:590-604``)."""
+        if total_batches == -1:
+            self.dataset.data_length = dict(self.full_data_length)
+        else:
+            self.dataset.data_length["train"] = total_batches * self.batch_size
+        self.dataset.switch_set(
+            set_name="train", current_iter=self.total_train_iters_produced
+        )
+        self.dataset.set_augmentation(augment_images=augment_images)
+        self.total_train_iters_produced += self.global_batch
+        yield from self._iter_batches(self.dataset.data_length["train"])
+
+    def get_val_batches(self, total_batches: int = -1, augment_images: bool = False):
+        """Validation batches from the fixed val seed (``data.py:607-620``)."""
+        if total_batches == -1:
+            self.dataset.data_length = dict(self.full_data_length)
+        else:
+            self.dataset.data_length["val"] = total_batches * self.batch_size
+        self.dataset.switch_set(set_name="val")
+        self.dataset.set_augmentation(augment_images=augment_images)
+        yield from self._iter_batches(self.dataset.data_length["val"])
+
+    def get_test_batches(self, total_batches: int = -1, augment_images: bool = False):
+        """Test batches from the fixed test seed (``data.py:623-636``)."""
+        if total_batches == -1:
+            self.dataset.data_length = dict(self.full_data_length)
+        else:
+            self.dataset.data_length["test"] = total_batches * self.batch_size
+        self.dataset.switch_set(set_name="test")
+        self.dataset.set_augmentation(augment_images=augment_images)
+        yield from self._iter_batches(self.dataset.data_length["test"])

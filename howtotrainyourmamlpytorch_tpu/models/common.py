@@ -63,3 +63,26 @@ def prepare_batch(data_batch):
     xs = xs.reshape(b, -1, *xs.shape[-3:])
     xt = xt.reshape(b, -1, *xt.shape[-3:])
     return xs, xt, ys.reshape(b, -1), yt.reshape(b, -1)
+
+
+class CheckpointableLearner:
+    """Reference trainer-contract checkpoint methods
+    (``few_shot_learning_system.py:399-424``): ``save_model`` writes the full
+    train-state pytree + experiment state to one file; ``load_model`` restores
+    both, rebuilding structure from a fresh ``init_state`` template."""
+
+    def save_model(self, model_save_dir: str, state, experiment_state: dict) -> None:
+        from ..utils.checkpoint import save_checkpoint
+
+        save_checkpoint(model_save_dir, state, experiment_state)
+
+    def load_model(self, model_save_dir: str, model_name: str, model_idx):
+        import os
+
+        import jax
+
+        from ..utils.checkpoint import load_checkpoint
+
+        filepath = os.path.join(model_save_dir, f"{model_name}_{model_idx}")
+        template = self.init_state(jax.random.PRNGKey(0))
+        return load_checkpoint(filepath, template)

@@ -36,6 +36,7 @@ from jax import lax
 from ..ops import accuracy, cross_entropy
 from .backbone import VGGBackbone
 from .common import (
+    CheckpointableLearner,
     cosine_epoch_lr,
     make_injected_adam,
     prepare_batch,
@@ -53,7 +54,7 @@ class GDState(NamedTuple):
     iteration: jax.Array
 
 
-class GradientDescentLearner:
+class GradientDescentLearner(CheckpointableLearner):
     """Reference trainer contract: ``run_train_iter`` / ``run_validation_iter``."""
 
     def __init__(self, cfg: MAMLConfig, mesh=None):

@@ -46,7 +46,12 @@ from ..inner_loop import init_lslr, lslr_update
 from ..ops import accuracy, cross_entropy
 from ..utils.trees import merge, partition
 from .backbone import BackboneConfig, VGGBackbone
-from .common import cosine_epoch_lr, prepare_batch, set_injected_lr
+from .common import (
+    CheckpointableLearner,
+    cosine_epoch_lr,
+    prepare_batch,
+    set_injected_lr,
+)
 
 Tree = Any
 
@@ -168,7 +173,7 @@ class TrainState(NamedTuple):
     iteration: jax.Array  # outer iterations taken (drives the LR schedule)
 
 
-class MAMLFewShotLearner:
+class MAMLFewShotLearner(CheckpointableLearner):
     """The MAML/MAML++ trainer: owns config, backbone, optimizer, and the
     compiled train/eval step functions.
 
@@ -541,11 +546,8 @@ class MAMLFewShotLearner:
         # (few_shot_learning_system.py:239); when that coincides with the
         # last eval step (the usual config) the final-only variant applies.
         final_only = (
-            min(
-                cfg.number_of_training_steps_per_iter,
-                cfg.number_of_evaluation_steps_per_iter,
-            )
-            == cfg.number_of_evaluation_steps_per_iter
+            cfg.number_of_evaluation_steps_per_iter
+            <= cfg.number_of_training_steps_per_iter
         )
         eval_fn = self._get_eval_step(final_only)
         metrics, logits = eval_fn(state, batch, self._eval_importance())

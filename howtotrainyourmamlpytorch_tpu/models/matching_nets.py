@@ -39,6 +39,7 @@ from jax import lax
 from ..ops import accuracy
 from .backbone import VGGBackbone
 from .common import (
+    CheckpointableLearner,
     cosine_epoch_lr,
     make_injected_adam,
     prepare_batch,
@@ -78,7 +79,7 @@ def cosine_attention_predictions(
     return attention @ onehot
 
 
-class MatchingNetsLearner:
+class MatchingNetsLearner(CheckpointableLearner):
     """Reference trainer contract: ``run_train_iter`` / ``run_validation_iter``."""
 
     def __init__(self, cfg: MAMLConfig, mesh=None, parity_bug: bool = False):

@@ -13,6 +13,7 @@ outer-gradient all-reduce over ICI (multi-host over DCN via
 from .mesh import (
     make_mesh,
     batch_sharding,
+    default_mesh_from_args,
     replicated,
     param_shardings,
     DEFAULT_DATA_AXIS,
@@ -22,6 +23,7 @@ from .distributed import initialize_distributed
 
 __all__ = [
     "make_mesh",
+    "default_mesh_from_args",
     "batch_sharding",
     "replicated",
     "param_shardings",

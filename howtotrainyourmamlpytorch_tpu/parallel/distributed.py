@@ -20,12 +20,13 @@ def initialize_distributed(
 ) -> None:
     """Initializes JAX's distributed runtime when running multi-host.
 
-    Calls ``jax.distributed.initialize`` (which includes cluster
-    auto-detection for Cloud TPU / GKE / Slurm) whenever any multi-host
-    signal is present: explicit args, ``JAX_NUM_PROCESSES`` /
-    ``JAX_COORDINATOR_ADDRESS`` env vars, or a detectable cluster
-    environment. Only a positively single-process run (no signal at all)
-    no-ops, so plain single-chip usage never blocks on coordination.
+    Opt-in by explicit signal only: passed args, or the
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` env vars. With a
+    signal present, ``jax.distributed.initialize`` fills any remaining
+    detail from its cluster auto-detection (Cloud TPU / GKE / Slurm).
+    Without one the call is a no-op — incidental cluster env vars (e.g. an
+    interactive shell inside a Slurm allocation) must not make a
+    single-process run block waiting for peers.
     """
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
@@ -36,17 +37,6 @@ def initialize_distributed(
         num_processes is not None and num_processes > 1
     )
     if not explicit:
-        try:  # private JAX registry; treat any failure as "no cluster"
-            from jax._src.clusters import ClusterEnv
-
-            detected = any(
-                env.is_env_present() for env in ClusterEnv._cluster_types
-            )
-        except Exception:
-            detected = False
-        if not detected:
-            return  # positively single-process
-    if num_processes is not None and num_processes <= 1 and not explicit:
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -41,6 +41,34 @@ def make_mesh(
     )
 
 
+def default_mesh_from_args(args) -> Mesh | None:
+    """Mesh for the CLI entry points: a ``dp``-only mesh over
+    ``data_parallel_devices`` (0 = all local) devices, or ``None`` on a
+    single device — the SPMD replacement for the reference's
+    if-multi-GPU-wrap-DataParallel (``few_shot_learning_system.py:73-81``).
+    The global meta-batch must divide over ``dp``."""
+    import jax as _jax
+
+    n = int(getattr(args, "data_parallel_devices", 0) or 0)
+    devices = _jax.devices()
+    if n <= 0:
+        n = len(devices)
+    if n == 1:
+        return None
+    # The loader's task axis is num_of_gpus * batch_size * samples_per_iter
+    # episodes (data/loader.py global_batch).
+    batch = (
+        int(getattr(args, "num_of_gpus", 1))
+        * int(args.batch_size)
+        * int(getattr(args, "samples_per_iter", 1))
+    )
+    if batch % n != 0:
+        raise ValueError(
+            f"global meta-batch {batch} not divisible by {n} mesh devices"
+        )
+    return make_mesh(devices[:n], data_parallel=n, model_parallel=1)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

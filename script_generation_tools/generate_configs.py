@@ -1,0 +1,110 @@
+"""Experiment config generator: cartesian hyperparameter sweep over $var$
+templates.
+
+Capability parity with the reference's
+``script_generation_tools/generate_configs.py`` (``:29-136``): for every
+(seed x dataset x shots x ways x batch x inner-lr x filters) combination,
+fill the matching ``experiment_template_config/*.json`` template by
+``$var$`` substitution and write it to ``experiment_config/``, named
+``<template>-<dataset>_<shots>_<batch>_<innerlr>_<filters>_<ways...>_<seed>
+.json``.
+"""
+
+from __future__ import annotations
+
+import os
+
+SEED_LIST = [0, 1, 2]
+
+# Per-dataset sweep ranges (the paper's experiment grid).
+HYPER = {
+    "omniglot": dict(
+        num_samples_per_class_range=[1, 5],
+        num_classes_range=[20, 5],
+        batch_size_range=[8],
+        init_inner_loop_learning_rate_range=[0.1],
+        num_filters=[64],
+        target_samples_per_class=1,
+    ),
+    "mini-imagenet": dict(
+        num_samples_per_class_range=[1, 5],
+        num_classes_range=[5],
+        batch_size_range=[2],
+        init_inner_loop_learning_rate_range=[0.01],
+        num_filters=[48],
+        target_samples_per_class=15,
+    ),
+}
+
+TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "..",
+                            "experiment_template_config")
+TARGET_DIR = os.path.join(os.path.dirname(__file__), "..", "experiment_config")
+
+
+def sweep(dataset_name: str):
+    h = HYPER[dataset_name]
+    for shots in h["num_samples_per_class_range"]:
+        for ways in h["num_classes_range"]:
+            for batch in h["batch_size_range"]:
+                for inner_lr in h["init_inner_loop_learning_rate_range"]:
+                    for filters in h["num_filters"]:
+                        yield dict(
+                            dataset_name=dataset_name,
+                            num_classes=ways,
+                            samples_per_class=shots,
+                            target_samples_per_class=h["target_samples_per_class"],
+                            batch_size=batch,
+                            train_update_steps=5,
+                            val_update_steps=5,
+                            init_inner_loop_learning_rate=inner_lr,
+                            load_into_memory=True,
+                            learnable_bn_gamma=True,
+                            learnable_bn_beta=True,
+                            conv_padding=True,
+                            num_filters=filters,
+                        )
+
+
+def fill_template(text: str, values: dict) -> str:
+    for key, item in values.items():
+        text = text.replace(f"${key}$", str(item).lower())
+    return text
+
+
+def main() -> None:
+    os.makedirs(TARGET_DIR, exist_ok=True)
+    for template_file in sorted(os.listdir(TEMPLATE_DIR)):
+        if not template_file.endswith(".json"):
+            continue
+        dataset_name = (
+            "omniglot" if "omniglot" in template_file else "mini-imagenet"
+        )
+        with open(os.path.join(TEMPLATE_DIR, template_file)) as f:
+            template = f.read()
+        for seed in SEED_LIST:
+            for values in sweep(dataset_name):
+                values = dict(values)
+                values["train_seed"] = seed
+                values["val_seed"] = 0
+                sweep_tag = "_".join(
+                    str(values[k])
+                    for k in (
+                        "num_classes", "samples_per_class", "batch_size",
+                        "init_inner_loop_learning_rate", "num_filters",
+                        "train_update_steps",
+                    )
+                )
+                values["experiment_name"] = (
+                    f"{dataset_name}_{sweep_tag}_{seed}"
+                )
+                out_name = "{}-{}.json".format(
+                    template_file.replace(".json", ""),
+                    values["experiment_name"],
+                )
+                with open(os.path.join(TARGET_DIR, out_name), "w") as f:
+                    f.write(fill_template(template, values))
+    print("configs written to", os.path.abspath(TARGET_DIR))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Runner-script generator: one shell script per experiment config.
+
+Capability parity with the reference's
+``script_generation_tools/generate_scripts.py`` (``:31-45``): for every JSON
+in ``experiment_config/``, fill ``local_run_template_script.sh``'s last line
+with the entry script + config name and write
+``experiment_scripts/<config>_few_shot.sh``.
+"""
+
+from __future__ import annotations
+
+import os
+
+SCRIPT_DIR = os.path.dirname(__file__)
+LOCAL_SCRIPT_DIR = os.path.join(SCRIPT_DIR, "..", "experiment_scripts")
+EXPERIMENT_JSON_DIR = os.path.join(SCRIPT_DIR, "..", "experiment_config")
+EXECUTION_SCRIPT = "train_maml_system.py"
+PREFIX = "few_shot"
+
+
+def main() -> None:
+    os.makedirs(LOCAL_SCRIPT_DIR, exist_ok=True)
+    with open(os.path.join(SCRIPT_DIR, "local_run_template_script.sh")) as f:
+        template = f.readlines()
+
+    for file in sorted(os.listdir(EXPERIMENT_JSON_DIR)):
+        if not file.endswith(".json"):
+            continue
+        lines = list(template)
+        lines[-1] = (
+            lines[-1]
+            .replace("$execution_script$", EXECUTION_SCRIPT)
+            .replace("$experiment_config$", file)
+        )
+        out = os.path.join(
+            LOCAL_SCRIPT_DIR, "{}_{}.sh".format(file.replace(".json", ""), PREFIX)
+        )
+        with open(out, "w") as f:
+            f.write("".join(lines))
+        os.chmod(out, 0o755)
+    print("scripts written to", os.path.abspath(LOCAL_SCRIPT_DIR))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Data pipeline tests: index build, split, deterministic episode sampling,
+augmentation, loader batching and resume seed fast-forward (SURVEY §4 test
+strategy — fixed-seed episode-sampler golden behavior)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.data import (
+    FewShotLearningDataset,
+    MetaLearningSystemDataLoader,
+    rotate_image,
+)
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import Bunch
+
+
+def make_dataset_dir(root, n_alphabets=4, n_chars=5, n_imgs=4, size=28):
+    rng = np.random.RandomState(0)
+    for a in range(n_alphabets):
+        for c in range(n_chars):
+            d = root / f"Alphabet{a}" / f"character{c:02d}"
+            d.mkdir(parents=True, exist_ok=True)
+            proto = rng.randint(0, 2, (size, size)) * 255
+            for i in range(n_imgs):
+                img = proto.copy()
+                flip = rng.rand(size, size) < 0.05
+                img[flip] = 255 - img[flip]
+                Image.fromarray(img.astype(np.uint8), mode="L").convert("1").save(
+                    str(d / f"{i}.png")
+                )
+
+
+def make_args(tmp_path, **overrides):
+    defaults = dict(
+        dataset_name="omniglot_mini",
+        dataset_path=str(tmp_path / "omniglot_mini"),
+        image_height=28,
+        image_width=28,
+        image_channels=1,
+        reset_stored_filepaths=False,
+        reverse_channels=False,
+        labels_as_int=False,
+        train_val_test_split=[0.5, 0.25, 0.25],
+        indexes_of_folders_indicating_class=[-3, -2],
+        num_target_samples=1,
+        num_samples_per_class=1,
+        num_classes_per_set=5,
+        train_seed=1,
+        val_seed=0,
+        sets_are_pre_split=False,
+        load_into_memory=False,
+        num_of_gpus=1,
+        batch_size=4,
+        samples_per_iter=1,
+        num_dataprovider_workers=2,
+    )
+    defaults.update(overrides)
+    return Bunch(defaults)
+
+
+@pytest.fixture
+def dataset_env(tmp_path, monkeypatch):
+    make_dataset_dir(tmp_path / "omniglot_mini")
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_index_cache_and_split(dataset_env):
+    args = make_args(dataset_env)
+    ds = FewShotLearningDataset(args)
+    # Index JSONs cached with the reference's filenames (data.py:244-248).
+    assert (dataset_env / "omniglot_mini.json").exists()
+    assert (dataset_env / "map_to_label_name_omniglot_mini.json").exists()
+    assert (dataset_env / "label_name_to_map_omniglot_mini.json").exists()
+    # 20 classes ratio-split 10/5/5.
+    assert len(ds.datasets["train"]) == 10
+    assert len(ds.datasets["val"]) == 5
+    assert len(ds.datasets["test"]) == 5
+    # Rebuilding from the cache gives the identical split (seeded shuffle).
+    ds2 = FewShotLearningDataset(args)
+    assert list(ds2.datasets["train"]) == list(ds.datasets["train"])
+
+
+def test_episode_determinism_and_shapes(dataset_env):
+    args = make_args(dataset_env)
+    ds = FewShotLearningDataset(args)
+    xs, xt, ys, yt, seed = ds.get_set("train", seed=123, augment_images=True)
+    assert xs.shape == (5, 1, 1, 28, 28)
+    assert xt.shape == (5, 1, 1, 28, 28)
+    assert ys.shape == (5, 1) and yt.shape == (5, 1)
+    # Each episode relabels classes 0..N-1.
+    assert sorted(ys[:, 0].tolist()) == [0, 1, 2, 3, 4]
+    # Same seed -> bitwise identical episode; different seed -> different.
+    xs2, *_ = ds.get_set("train", seed=123, augment_images=True)
+    np.testing.assert_array_equal(xs, xs2)
+    xs3, *_ = ds.get_set("train", seed=124, augment_images=True)
+    assert not np.array_equal(xs, xs3)
+
+
+def test_val_and_test_seeds_fixed(dataset_env):
+    """Val/test use the derived val seed; test == val (data.py:136-142)."""
+    args = make_args(dataset_env)
+    ds = FewShotLearningDataset(args)
+    assert ds.init_seed["test"] == ds.init_seed["val"]
+    assert ds.init_seed["train"] != ds.init_seed["val"]
+
+
+def test_rotation_augment_applied_only_in_train(dataset_env):
+    args = make_args(dataset_env)
+    ds = FewShotLearningDataset(args)
+    # Find a seed whose first episode class draws k != 0.
+    for seed in range(50):
+        rng = np.random.RandomState(seed)
+        classes = rng.choice(
+            list(ds.dataset_size_dict["train"].keys()), size=5, replace=False
+        )
+        rng.shuffle(classes)
+        if rng.randint(0, 4, 5)[0] != 0:
+            break
+    plain, *_ = ds.get_set("train", seed=seed, augment_images=False)
+    rotated, *_ = ds.get_set("train", seed=seed, augment_images=True)
+    assert not np.array_equal(plain, rotated)
+
+
+def test_rotate_image_quarter_turns():
+    im = np.arange(12, dtype=np.float32).reshape(3, 4, 1)
+    r1 = rotate_image(im, 1)
+    assert r1.shape == (4, 3, 1)
+    np.testing.assert_array_equal(rotate_image(im, 4), im)
+
+
+def test_loader_batching_and_resume(dataset_env):
+    args = make_args(dataset_env)
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+    batches = list(loader.get_train_batches(total_batches=3, augment_images=False))
+    assert len(batches) == 3
+    xs, xt, ys, yt, seeds = batches[0]
+    assert xs.shape == (4, 5, 1, 1, 28, 28)
+    assert seeds.shape == (4,)
+
+    # A loader resumed at iteration 2 reproduces batch index 2 exactly
+    # (data.py:583-588 seed fast-forward).
+    resumed = MetaLearningSystemDataLoader(args, current_iter=2)
+    resumed_batches = list(
+        resumed.get_train_batches(total_batches=1, augment_images=False)
+    )
+    np.testing.assert_array_equal(batches[2][0], resumed_batches[0][0])
+    np.testing.assert_array_equal(batches[2][4], resumed_batches[0][4])
+
+
+def test_loader_val_batches_repeatable(dataset_env):
+    args = make_args(dataset_env)
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+    a = list(loader.get_val_batches(total_batches=2))
+    b = list(loader.get_val_batches(total_batches=2))
+    np.testing.assert_array_equal(a[0][0], b[0][0])
+    np.testing.assert_array_equal(a[1][0], b[1][0])
+
+
+def test_ram_preload_matches_disk(dataset_env):
+    args = make_args(dataset_env)
+    disk = FewShotLearningDataset(args)
+    ram = FewShotLearningDataset(make_args(dataset_env, load_into_memory=True))
+    e_disk = disk.get_set("val", seed=7, augment_images=False)
+    e_ram = ram.get_set("val", seed=7, augment_images=False)
+    np.testing.assert_allclose(e_disk[0], e_ram[0])
+    np.testing.assert_array_equal(e_disk[2], e_ram[2])

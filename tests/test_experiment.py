@@ -1,0 +1,237 @@
+"""Experiment runtime tests: config parsing, storage, checkpoint roundtrip,
+and an end-to-end ExperimentBuilder run with pause/resume and ensemble test
+(SURVEY §4 — the reference has no tests; this is the from-scratch strategy)."""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+from howtotrainyourmamlpytorch_tpu.utils import storage
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+    args_to_maml_config,
+    get_args,
+)
+
+from test_data import make_args, make_dataset_dir
+
+
+# ---------------------------------------------------------------------------
+# Config system (C19)
+# ---------------------------------------------------------------------------
+
+
+def test_get_args_json_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    cfg = {
+        "batch_size": 8,
+        "second_order": True,
+        "continue_from_epoch": 7,  # must be IGNORED (parser_utils.py:103)
+        "gpu_to_use": 3,  # must be IGNORED
+        "per_step_bn_statistics": "true",  # string -> bool coercion
+        "dataset_path": "omniglot_dataset",
+    }
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(json.dumps(cfg))
+    args, device = get_args(["--name_of_args_json_file", str(cfg_file)])
+    assert args.batch_size == 8
+    assert args.second_order is True
+    assert args.per_step_bn_statistics is True
+    assert args.continue_from_epoch == "latest"  # CLI default survives
+    assert args.gpu_to_use is None
+    assert args.dataset_path == os.path.join(str(tmp_path), "omniglot_dataset")
+
+
+def test_args_to_maml_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    cfg = {
+        "dataset_name": "mini_imagenet_full_size",
+        "image_height": 84, "image_width": 84, "image_channels": 3,
+        "cnn_num_filters": 48, "num_stages": 4,
+        "number_of_training_steps_per_iter": 5,
+        "number_of_evaluation_steps_per_iter": 5,
+        "per_step_bn_statistics": True,
+        "init_inner_loop_learning_rate": 0.01,
+        "num_classes_per_set": 5,
+        "max_pooling": True, "conv_padding": True,
+        "second_order": True,
+    }
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(json.dumps(cfg))
+    args, _ = get_args(["--name_of_args_json_file", str(cfg_file)])
+    mc = args_to_maml_config(args)
+    assert mc.backbone.num_filters == 48
+    assert mc.backbone.image_height == 84
+    assert mc.backbone.per_step_bn_statistics
+    # init_inner_loop_learning_rate honored when task_learning_rate is default
+    assert mc.task_learning_rate == 0.01
+    # ImageNet grad clamp (few_shot_learning_system.py:332-335)
+    assert mc.clip_grad_value == 10.0
+
+
+# ---------------------------------------------------------------------------
+# Storage (C18)
+# ---------------------------------------------------------------------------
+
+
+def test_storage_csv_roundtrip(tmp_path):
+    exp = str(tmp_path)
+    storage.save_statistics(exp, ["a", "b"], create=True)
+    storage.save_statistics(exp, [1, 2])
+    storage.save_statistics(exp, [3, 4])
+    loaded = storage.load_statistics(exp)
+    assert loaded["a"] == ["1", "3"]
+    assert loaded["b"] == ["2", "4"]
+
+
+def test_build_experiment_folder(tmp_path):
+    saved, logs, samples = storage.build_experiment_folder(str(tmp_path / "exp"))
+    for p in (saved, logs, samples):
+        assert os.path.isdir(p)
+    assert saved.endswith("saved_models")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint (SURVEY §5 checkpoint/resume)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg():
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2, num_filters=4, per_step_bn_statistics=True,
+            num_steps=2, num_classes=5,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_epochs=2, total_iter_per_epoch=2,
+    )
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    learner = MAMLFewShotLearner(_tiny_cfg())
+    state = learner.init_state(jax.random.PRNGKey(3))
+    exp_state = {"current_iter": 7, "best_val_acc": 0.5,
+                 "per_epoch_statistics": {"val_accuracy_mean": [0.5]}}
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state, exp_state)
+    template = learner.init_state(jax.random.PRNGKey(0))
+    restored, exp_restored = load_checkpoint(path, template)
+    assert exp_restored["current_iter"] == 7
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_shape_mismatch_raises(tmp_path):
+    learner = MAMLFewShotLearner(_tiny_cfg())
+    state = learner.init_state(jax.random.PRNGKey(3))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state, {})
+    other = MAMLFewShotLearner(
+        MAMLConfig(
+            backbone=BackboneConfig(num_stages=2, num_filters=8, num_classes=5),
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+        )
+    )
+    with pytest.raises(ValueError, match="shape"):
+        load_checkpoint(path, other.init_state(jax.random.PRNGKey(0)))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end ExperimentBuilder (CPU, tiny)
+# ---------------------------------------------------------------------------
+
+
+def _experiment_args(tmp_path):
+    return make_args(
+        tmp_path,
+        experiment_name=str(tmp_path / "exp"),
+        seed=104,
+        continue_from_epoch="latest",
+        max_models_to_save=5,
+        total_epochs=3,
+        total_iter_per_epoch=2,
+        total_epochs_before_pause=100,
+        num_evaluation_tasks=8,
+        evaluate_on_test_set_only=False,
+        batch_size=2,
+        model="maml++",
+        # learner config keys
+        num_stages=2, cnn_num_filters=4, conv_padding=True, max_pooling=True,
+        norm_layer="batch_norm", per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        num_classes_per_set=5, second_order=False,
+        first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True, multi_step_loss_num_epochs=2,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True, learnable_bn_beta=True,
+        meta_learning_rate=0.001, min_learning_rate=1e-5,
+        task_learning_rate=0.1, init_inner_loop_learning_rate=0.1,
+    )
+
+
+def test_experiment_builder_end_to_end(tmp_path, monkeypatch):
+    make_dataset_dir(tmp_path / "omniglot_mini")
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    args = _experiment_args(tmp_path)
+    model = MAMLFewShotLearner(args_to_maml_config(args))
+    builder = ExperimentBuilder(
+        args=args, data=MetaLearningSystemDataLoader, model=model, device=None
+    )
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+
+    logs = os.path.join(str(tmp_path / "exp"), "logs")
+    saved = os.path.join(str(tmp_path / "exp"), "saved_models")
+    stats = storage.load_statistics(logs)
+    assert len(stats["epoch"]) == 3
+    assert "train_accuracy_mean" in stats and "val_accuracy_mean" in stats
+    assert os.path.exists(os.path.join(saved, "train_model_3"))
+    assert os.path.exists(os.path.join(saved, "train_model_latest"))
+    assert os.path.exists(os.path.join(logs, "test_summary.csv"))
+    assert os.path.exists(os.path.join(logs, "summary_statistics.json"))
+
+
+def test_experiment_builder_resume(tmp_path, monkeypatch):
+    make_dataset_dir(tmp_path / "omniglot_mini")
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    args = _experiment_args(tmp_path)
+
+    # Phase 1: pause after 1 epoch (sys.exit, experiment_builder.py:365-368).
+    args.total_epochs_before_pause = 1
+    model = MAMLFewShotLearner(args_to_maml_config(args))
+    builder = ExperimentBuilder(
+        args=args, data=MetaLearningSystemDataLoader, model=model, device=None
+    )
+    with pytest.raises(SystemExit):
+        builder.run_experiment()
+    assert builder.state["current_iter"] == 2
+
+    # Phase 2: resume from latest and finish.
+    args2 = _experiment_args(tmp_path)
+    model2 = MAMLFewShotLearner(args_to_maml_config(args2))
+    builder2 = ExperimentBuilder(
+        args=args2, data=MetaLearningSystemDataLoader, model=model2, device=None
+    )
+    assert builder2.state["current_iter"] == 2
+    assert builder2.epoch == 1
+    builder2.run_experiment()
+    stats = storage.load_statistics(os.path.join(str(tmp_path / "exp"), "logs"))
+    assert len(stats["epoch"]) == 3  # 1 from phase one + 2 after resume

@@ -1,0 +1,22 @@
+"""Gradient-descent (transfer/joint-training) baseline entry point
+(reference ``train_gradient_descent_system.py:1-15``)."""
+
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+from howtotrainyourmamlpytorch_tpu.models import GradientDescentLearner
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+    args_to_maml_config,
+    get_args,
+)
+
+if __name__ == "__main__":
+    initialize_distributed()  # no-op without explicit multi-host env signal
+    args, device = get_args()
+    model = GradientDescentLearner(cfg=args_to_maml_config(args))
+    maybe_unzip_dataset(args)
+    system = ExperimentBuilder(
+        model=model, data=MetaLearningSystemDataLoader, args=args, device=device
+    )
+    system.run_experiment()
