@@ -150,9 +150,11 @@ class GradientDescentLearner(CheckpointableLearner):
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
         new_state, metrics, _ = self._train_step(state, batch)
+        # Device scalars: callers float() them only when read (lazy metrics
+        # keep the dispatch pipeline full — see maml.run_train_iter).
         losses = {
-            "loss": float(metrics["loss"]),
-            "accuracy": float(metrics["accuracy"]),
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
             "learning_rate": lr,
         }
         return new_state, losses
@@ -163,7 +165,7 @@ class GradientDescentLearner(CheckpointableLearner):
         batch = prepare_batch(data_batch)
         new_state, metrics, logits = self._eval_step(state, batch)
         losses = {
-            "loss": float(metrics["loss"]),
-            "accuracy": float(metrics["accuracy"]),
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
         }
-        return new_state, losses, np.asarray(logits)
+        return new_state, losses, logits

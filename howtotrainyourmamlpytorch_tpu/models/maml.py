@@ -229,6 +229,89 @@ class MAMLFewShotLearner(CheckpointableLearner):
             )
         return self._eval_steps[final_only]
 
+    def _get_multi_train_step(self, second_order: bool, final_only: bool):
+        """K meta-updates in ONE device program: ``lax.scan`` over a stacked
+        batch axis. Amortizes per-dispatch host/runtime latency (the
+        dominant cost for small models — measured ~26 ms/dispatch vs
+        sub-ms step compute) without changing per-iteration semantics."""
+        key = ("multi", second_order, final_only)
+        if key not in self._train_steps:
+
+            def multi(state: TrainState, batches, importance):
+                def body(carry, batch):
+                    new_state, metrics = self._train_step(
+                        carry, batch, importance,
+                        second_order=second_order, final_only=final_only,
+                    )
+                    return new_state, metrics
+
+                state, metrics = lax.scan(body, state, batches)
+                return state, jax.tree.map(lambda m: m[-1], metrics)
+
+            jit_kwargs = {}
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel.mesh import DEFAULT_DATA_AXIS, replicated
+
+                # Same sharding rules as the single-step path: the task axis
+                # (second axis here, after the leading K scan axis) over
+                # 'dp', state and importance replicated.
+                jit_kwargs["in_shardings"] = (
+                    replicated(self.mesh),
+                    NamedSharding(self.mesh, P(None, DEFAULT_DATA_AXIS)),
+                    replicated(self.mesh),
+                )
+            self._train_steps[key] = jax.jit(
+                multi, donate_argnums=(0,), **jit_kwargs
+            )
+        return self._train_steps[key]
+
+    def run_train_iters(self, state: TrainState, data_batches, epoch):
+        """Runs ``K`` consecutive meta-updates in one dispatch.
+
+        ``data_batches``: a sequence of K episode batches, or the pre-stacked
+        form — a 4-tuple of *prepared* arrays (``prepare_batch`` layout) each
+        with a leading K axis. Returns ``(state, losses)`` with the last
+        iteration's metrics (device scalars, lazy)."""
+        epoch = int(epoch)
+        self.current_epoch = epoch
+        # Pre-stacked form: exactly 4 array-likes (np or device arrays).
+        # A sequence of episode batches has tuples as elements instead.
+        if len(data_batches) == 4 and all(
+            hasattr(b, "ndim") for b in data_batches
+        ):
+            batches = tuple(data_batches)
+        else:
+            prepared = [self._prepare_batch(b) for b in data_batches]
+            batches = tuple(
+                np.stack([p[i] for p in prepared]) for i in range(4)
+            )
+        importance = self._train_importance(epoch)
+        lr = self._epoch_lr(epoch)
+        state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
+        final_only = not (
+            self.cfg.use_multi_step_loss_optimization
+            and epoch < self.cfg.multi_step_loss_num_epochs
+        )
+        step_fn = self._get_multi_train_step(
+            self._use_second_order(epoch), final_only
+        )
+        new_state, metrics = step_fn(state, batches, importance)
+        losses = {
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
+        }
+        msl_vector = per_step_loss_importance(
+            epoch,
+            self.cfg.number_of_training_steps_per_iter,
+            self.cfg.multi_step_loss_num_epochs,
+        )
+        for i, v in enumerate(msl_vector):
+            losses[f"loss_importance_vector_{i}"] = float(v)
+        losses["learning_rate"] = lr
+        return new_state, losses
+
     # ------------------------------------------------------------------
     # Initialization
     # ------------------------------------------------------------------
@@ -522,9 +605,14 @@ class MAMLFewShotLearner(CheckpointableLearner):
         )
         step_fn = self._get_train_step(self._use_second_order(epoch), final_only)
         new_state, metrics = step_fn(state, batch, importance)
+        # Metrics stay as device scalars: converting here would block the
+        # host on every dispatch and serialize the pipeline (measured ~8x
+        # throughput loss through the device tunnel). Callers force them
+        # with float() only when they actually read (epoch boundaries,
+        # periodic prints).
         losses = {
-            "loss": float(metrics["loss"]),
-            "accuracy": float(metrics["accuracy"]),
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
         }
         msl_vector = per_step_loss_importance(
             epoch,
@@ -552,7 +640,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         eval_fn = self._get_eval_step(final_only)
         metrics, logits = eval_fn(state, batch, self._eval_importance())
         losses = {
-            "loss": float(metrics["loss"]),
-            "accuracy": float(metrics["accuracy"]),
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
         }
-        return state, losses, np.asarray(logits)
+        return state, losses, logits

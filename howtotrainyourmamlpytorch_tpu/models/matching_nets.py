@@ -171,9 +171,11 @@ class MatchingNetsLearner(CheckpointableLearner):
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
         new_state, metrics, _ = self._train_step(state, batch)
+        # Device scalars: callers float() them only when read (lazy metrics
+        # keep the dispatch pipeline full — see maml.run_train_iter).
         losses = {
-            "loss": float(metrics["loss"]),
-            "accuracy": float(metrics["accuracy"]),
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
             "learning_rate": lr,
         }
         return new_state, losses
@@ -182,7 +184,7 @@ class MatchingNetsLearner(CheckpointableLearner):
         batch = prepare_batch(data_batch)
         _, metrics, preds = self._eval_step(state, batch)
         losses = {
-            "loss": float(metrics["loss"]),
-            "accuracy": float(metrics["accuracy"]),
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
         }
-        return state, losses, np.asarray(preds)
+        return state, losses, preds

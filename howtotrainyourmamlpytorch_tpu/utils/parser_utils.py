@@ -101,6 +101,8 @@ def get_parser() -> argparse.ArgumentParser:
     # TPU-specific extensions (absent from the reference).
     add("--compute_dtype", type=str, default="float32",
         help="float32 | bfloat16 (MXU-native)")
+    add("--iters_per_dispatch", type=int, default=1,
+        help="K meta-updates per device dispatch (lax.scan iteration batching)")
     add("--data_parallel_devices", type=int, default=0,
         help="0 = all local devices; shards the task axis over the mesh")
     return parser
