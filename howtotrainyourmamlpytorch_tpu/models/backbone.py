@@ -70,6 +70,11 @@ class BackboneConfig:
     image_width: int = 28
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
+    # Fused Pallas bn+leaky_relu kernel (ops/pallas_fused_norm.py). Its
+    # custom_vjp supports one level of reverse-mode AD: valid for eval,
+    # first-order MAML, and the baselines; second-order paths must keep the
+    # lax batch_norm (callers pass fused=False there).
+    use_pallas_fused_norm: bool = False
 
     @property
     def conv_stride(self) -> int:
@@ -188,6 +193,7 @@ class VGGBackbone:
         step,
         *,
         training: bool = True,
+        fused: bool | None = None,
     ) -> tuple[jax.Array, Params]:
         """Forward pass.
 
@@ -205,6 +211,7 @@ class VGGBackbone:
         """
         del training
         cfg = self.cfg
+        use_fused = cfg.use_pallas_fused_norm if fused is None else fused
         new_bn_state: Params = {}
         out = x
         for i in range(cfg.num_stages):
@@ -217,20 +224,32 @@ class VGGBackbone:
                 padding=cfg.conv_padding,
             )
             if cfg.norm_layer == "batch_norm":
-                out, new_bn_state[f"conv{i}"] = batch_norm(
-                    out,
-                    stage["norm"]["gamma"],
-                    stage["norm"]["beta"],
-                    bn_state[f"conv{i}"],
-                    step,
-                    momentum=cfg.bn_momentum,
-                    eps=cfg.bn_eps,
-                )
+                if use_fused:
+                    out, new_bn_state[f"conv{i}"] = self._fused_norm_act(
+                        out,
+                        stage["norm"]["gamma"],
+                        stage["norm"]["beta"],
+                        bn_state[f"conv{i}"],
+                        step,
+                    )
+                else:
+                    out, new_bn_state[f"conv{i}"] = batch_norm(
+                        out,
+                        stage["norm"]["gamma"],
+                        stage["norm"]["beta"],
+                        bn_state[f"conv{i}"],
+                        step,
+                        momentum=cfg.bn_momentum,
+                        eps=cfg.bn_eps,
+                    )
+                    out = jax.nn.leaky_relu(out, negative_slope=0.01)
             elif cfg.norm_layer == "layer_norm":
                 out = layer_norm(
                     out, stage["norm"]["weight"], stage["norm"]["bias"], eps=cfg.bn_eps
                 )
-            out = jax.nn.leaky_relu(out, negative_slope=0.01)
+                out = jax.nn.leaky_relu(out, negative_slope=0.01)
+            else:
+                out = jax.nn.leaky_relu(out, negative_slope=0.01)
             if cfg.max_pooling:
                 out = max_pool2d(out, 2, 2)
 
@@ -240,6 +259,48 @@ class VGGBackbone:
         out = out.reshape(out.shape[0], -1)
         logits = linear(out, params["linear"]["weight"], params["linear"]["bias"])
         return logits, new_bn_state
+
+    def _fused_norm_act(self, x, gamma, beta, state, step):
+        """Pallas fused bn+leaky_relu + the same running-stat update as
+        ``ops/norm.batch_norm`` (torch semantics: unbiased var, momentum
+        mix), with per-step row select/scatter."""
+        import jax.numpy as jnp
+
+        from ..ops.norm import BatchNormState
+        from ..ops.pallas_fused_norm import fused_bn_leaky_relu
+
+        cfg = self.cfg
+        step = jnp.asarray(step)
+        if gamma.ndim == 2:
+            s = jnp.minimum(step, gamma.shape[0] - 1)
+            gamma_row, beta_row = gamma[s], beta[s]
+        else:
+            gamma_row, beta_row = gamma, beta
+        # Interpreter mode off-TPU (CPU tests); real kernels otherwise.
+        interpret = jax.default_backend() == "cpu"
+        out, mean, var = fused_bn_leaky_relu(
+            x, gamma_row.astype(jnp.float32), beta_row.astype(jnp.float32),
+            cfg.bn_eps, 0.01, interpret,
+        )
+        n = x.shape[0] * x.shape[2] * x.shape[3]
+        var_unbiased = var * (n / max(n - 1, 1))
+        m = cfg.bn_momentum
+        if state.running_mean.ndim == 2:
+            s = jnp.minimum(step, state.running_mean.shape[0] - 1)
+            new_state = BatchNormState(
+                running_mean=state.running_mean.at[s].set(
+                    (1.0 - m) * state.running_mean[s] + m * mean
+                ),
+                running_var=state.running_var.at[s].set(
+                    (1.0 - m) * state.running_var[s] + m * var_unbiased
+                ),
+            )
+        else:
+            new_state = BatchNormState(
+                running_mean=(1.0 - m) * state.running_mean + m * mean,
+                running_var=(1.0 - m) * state.running_var + m * var_unbiased,
+            )
+        return out, new_state
 
     # ------------------------------------------------------------------
     # Inner-loop parameter partition

@@ -432,12 +432,18 @@ class MAMLFewShotLearner(CheckpointableLearner):
         x_target = x_target.astype(compute_dtype)
         if final_only:
             assert pred_step is None or pred_step == num_steps - 1
+        # The fused Pallas norm kernel's custom_vjp supports one level of
+        # reverse-mode AD — fine for first-order variants (incl. eval), not
+        # for reverse-over-reverse; second-order keeps the lax path.
+        fused = backbone.cfg.use_pallas_fused_norm and not second_order
 
         def step_fn(carry, step):
             fast, bn = carry
 
             def support_loss_fn(fast_):
-                logits, bn1 = backbone.apply(merge(fast_, frozen), bn, x_support, step)
+                logits, bn1 = backbone.apply(
+                    merge(fast_, frozen), bn, x_support, step, fused=fused
+                )
                 return cross_entropy(logits, y_support), bn1
 
             (s_loss, bn1), grads = jax.value_and_grad(support_loss_fn, has_aux=True)(
@@ -448,7 +454,9 @@ class MAMLFewShotLearner(CheckpointableLearner):
             fast = lslr_update(fast, grads, lslr, step)
             if final_only:
                 return (fast, bn1), s_loss
-            t_logits, bn2 = backbone.apply(merge(fast, frozen), bn1, x_target, step)
+            t_logits, bn2 = backbone.apply(
+                merge(fast, frozen), bn1, x_target, step, fused=fused
+            )
             t_loss = cross_entropy(t_logits, y_target)
             return (fast, bn2), (s_loss, t_loss, t_logits)
 
@@ -460,7 +468,8 @@ class MAMLFewShotLearner(CheckpointableLearner):
                 step_fn, (adapt0, bn_state), jnp.arange(num_steps)
             )
             t_logits, bn_final = backbone.apply(
-                merge(fast_final, frozen), bn_final, x_target, num_steps - 1
+                merge(fast_final, frozen), bn_final, x_target, num_steps - 1,
+                fused=fused,
             )
             weighted = cross_entropy(t_logits, y_target)
             t_losses = weighted[None]

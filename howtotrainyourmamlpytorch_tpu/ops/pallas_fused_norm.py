@@ -1,0 +1,217 @@
+"""Pallas TPU kernel: fused batch-norm (batch statistics) + LeakyReLU.
+
+The backbone's hot elementwise chain is ``conv -> batch_norm -> leaky_relu``
+(reference ``meta_neural_network_architectures.py:385-426``; our
+``models/backbone.py``). XLA fuses the affine/activation pieces but still
+materializes the normalization as separate reduction + map ops; this kernel
+performs the whole stats+normalize+affine+activation chain in ONE VMEM
+round trip: the activation block is loaded once, per-channel mean/variance
+are reduced on the VPU, and the normalized, scaled, shifted, activated
+result is written straight back — plus the batch mean/var as byproducts for
+the running-statistics update.
+
+Layout: the (N, C, H, W) activation is viewed as (R, C) with R = N*H*W so
+the channel axis rides the 128-wide lane dimension. Both R and C are padded
+to the fp32 (8, 128) tile.
+
+Differentiation: exposed via ``jax.custom_vjp`` with the backward pass as a
+second Pallas kernel (standard batch-norm backward through the batch
+statistics, fused with the LeakyReLU mask). ``custom_vjp`` supports ONE
+level of reverse-mode AD — exactly what every first-order path needs (eval,
+first-order MAML, the GD and matching-nets baselines). Second-order MAML
+keeps the pure-lax ``ops/norm.batch_norm`` path, which XLA differentiates
+twice natively; the backbone selects per-path (``models/backbone.py``).
+
+Numerics: statistics and normalization are computed in fp32 regardless of
+input dtype (bf16-safe), matching ``ops/norm.batch_norm``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return (value + multiple - 1) // multiple * multiple
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref,
+                *, rows: int, eps: float, slope: float):
+    """One block: x (Rp, Cp) fp32 in VMEM; rows = real R (Rp-rows padding)."""
+    x = x_ref[:].astype(jnp.float32)
+    rp = x.shape[0]
+    # Mask padded rows out of the statistics.
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    valid = row_ids < rows
+    xm = jnp.where(valid, x, 0.0)
+    inv_n = 1.0 / rows
+    mean = jnp.sum(xm, axis=0, keepdims=True) * inv_n
+    sq = jnp.sum(jnp.where(valid, x * x, 0.0), axis=0, keepdims=True) * inv_n
+    var = sq - mean * mean
+    inv = jax.lax.rsqrt(var + eps)
+    pre = (x - mean) * inv * gamma_ref[:] + beta_ref[:]
+    y = jnp.where(pre >= 0, pre, slope * pre)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    var_ref[:] = var
+
+
+# ---------------------------------------------------------------------------
+# Backward kernel
+# ---------------------------------------------------------------------------
+
+
+def _bwd_kernel(x_ref, gamma_ref, beta_ref, mean_ref, var_ref, g_ref,
+                dx_ref, dgamma_ref, dbeta_ref,
+                *, rows: int, eps: float, slope: float):
+    """Batch-norm backward (through batch stats) fused with the LeakyReLU
+    mask. All math fp32."""
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    mean = mean_ref[:]
+    var = var_ref[:]
+    gamma = gamma_ref[:]
+    inv = jax.lax.rsqrt(var + eps)
+
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    valid = row_ids < rows
+    inv_n = 1.0 / rows
+
+    xhat = (x - mean) * inv
+    pre = xhat * gamma + beta_ref[:]
+    dpre = jnp.where(pre >= 0, g, slope * g)
+    dpre = jnp.where(valid, dpre, 0.0)
+
+    dgamma = jnp.sum(dpre * xhat, axis=0, keepdims=True)
+    dbeta = jnp.sum(dpre, axis=0, keepdims=True)
+
+    dxhat = dpre * gamma
+    sum_dxhat = jnp.sum(dxhat, axis=0, keepdims=True)
+    sum_dxhat_xhat = jnp.sum(dxhat * xhat, axis=0, keepdims=True)
+    # dx = inv/N * (N*dxhat - sum(dxhat) - xhat * sum(dxhat*xhat))
+    dx = inv * (dxhat - inv_n * sum_dxhat - xhat * inv_n * sum_dxhat_xhat)
+    dx_ref[:] = jnp.where(valid, dx, 0.0).astype(dx_ref.dtype)
+    dgamma_ref[:] = dgamma
+    dbeta_ref[:] = dbeta
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrappers (2-D padded views)
+# ---------------------------------------------------------------------------
+
+
+def _pad2d(a: jax.Array, rp: int, cp: int) -> jax.Array:
+    return jnp.pad(a, ((0, rp - a.shape[0]), (0, cp - a.shape[1])))
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "slope", "interpret"))
+def _fused_fwd_2d(x2d, gamma, beta, *, eps, slope, interpret):
+    rows, cols = x2d.shape
+    rp, cp = _round_up(rows, 8), _round_up(cols, 128)
+    xp = _pad2d(x2d, rp, cp)
+    gp = jnp.pad(gamma, (0, cp - cols)).astype(jnp.float32)[None, :]
+    bp = jnp.pad(beta, (0, cp - cols)).astype(jnp.float32)[None, :]
+    y, mean, var = pl.pallas_call(
+        functools.partial(_fwd_kernel, rows=rows, eps=eps, slope=slope),
+        out_shape=(
+            jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        interpret=interpret,
+    )(xp, gp, bp)
+    return y[:rows, :cols], mean[0, :cols], var[0, :cols]
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "slope", "interpret"))
+def _fused_bwd_2d(x2d, gamma, beta, mean, var, g2d, *, eps, slope, interpret):
+    rows, cols = x2d.shape
+    rp, cp = _round_up(rows, 8), _round_up(cols, 128)
+    xp = _pad2d(x2d, rp, cp)
+    gp = jnp.pad(g2d, ((0, rp - rows), (0, cp - cols)))
+    gamma_p = jnp.pad(gamma, (0, cp - cols)).astype(jnp.float32)[None, :]
+    beta_p = jnp.pad(beta, (0, cp - cols)).astype(jnp.float32)[None, :]
+    # Padded channels get var=0 -> rsqrt(eps) finite, grads masked by zeros.
+    mean_p = jnp.pad(mean, (0, cp - cols)).astype(jnp.float32)[None, :]
+    var_p = jnp.pad(var, (0, cp - cols)).astype(jnp.float32)[None, :]
+    dx, dgamma, dbeta = pl.pallas_call(
+        functools.partial(_bwd_kernel, rows=rows, eps=eps, slope=slope),
+        out_shape=(
+            jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        interpret=interpret,
+    )(xp, gamma_p, beta_p, mean_p, var_p, gp)
+    return dx[:rows, :cols], dgamma[0, :cols], dbeta[0, :cols]
+
+
+# ---------------------------------------------------------------------------
+# Public op: (N, C, H, W) fused bn+leaky_relu with custom VJP
+# ---------------------------------------------------------------------------
+
+
+def _to_2d(x: jax.Array) -> jax.Array:
+    n, c, h, w = x.shape
+    return jnp.transpose(x, (0, 2, 3, 1)).reshape(n * h * w, c)
+
+
+def _from_2d(x2d: jax.Array, shape) -> jax.Array:
+    n, c, h, w = shape
+    return jnp.transpose(x2d.reshape(n, h, w, c), (0, 3, 1, 2))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_bn_leaky_relu(x, gamma, beta, eps=1e-5, slope=0.01, interpret=False):
+    """``leaky_relu(batch_norm(x) * gamma + beta)`` + batch stats, fused.
+
+    Args:
+      x: ``(N, C, H, W)`` activations.
+      gamma / beta: ``(C,)`` scale/shift (per-step rows already selected).
+      eps / slope: BN epsilon, LeakyReLU negative slope.
+      interpret: run the kernels in interpreter mode (CPU tests).
+
+    Returns:
+      ``(y (N, C, H, W), batch_mean (C,), batch_var (C,))`` — var biased, as
+      used for normalization; callers apply the unbiased correction for
+      running stats (see ``ops/norm.batch_norm``).
+    """
+    y, mean, var = _fused_fwd_2d(
+        _to_2d(x), gamma, beta, eps=eps, slope=slope, interpret=interpret
+    )
+    return _from_2d(y, x.shape), mean, var
+
+
+def _fused_vjp_fwd(x, gamma, beta, eps, slope, interpret):
+    x2d = _to_2d(x)
+    y, mean, var = _fused_fwd_2d(
+        x2d, gamma, beta, eps=eps, slope=slope, interpret=interpret
+    )
+    return (_from_2d(y, x.shape), mean, var), (x2d, gamma, beta, mean, var, x.shape)
+
+
+def _fused_vjp_bwd(eps, slope, interpret, residuals, cotangents):
+    x2d, gamma, beta, mean, var, shape = residuals
+    gy, _gmean, _gvar = cotangents  # stats byproducts treated as non-diff
+    dx2d, dgamma, dbeta = _fused_bwd_2d(
+        x2d, gamma, beta, mean, var, _to_2d(gy),
+        eps=eps, slope=slope, interpret=interpret,
+    )
+    return _from_2d(dx2d, shape), dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype)
+
+
+fused_bn_leaky_relu.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
