@@ -1,0 +1,48 @@
+"""Platform selection helpers for virtual multi-device CPU meshes.
+
+Multi-chip sharding is validated without real chips by retargeting JAX to an
+N-device virtual CPU platform (SURVEY §4: ``--xla_force_host_platform_device_count``).
+The switch must happen before any XLA backend initializes; once a backend is
+up, ``jax_platforms`` updates are silent no-ops (the config value is read once
+inside a memoized init path).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu(n_devices: int) -> list:
+    """Force the CPU platform with ``n_devices`` virtual devices.
+
+    Must be called before any JAX backend initializes (conftest/driver entry
+    points call it first thing). Sets both the env vars (for child processes
+    and pre-import callers) and ``jax.config`` (for processes where ``jax``
+    is already imported, e.g. under the axon sitecustomize, but no backend
+    has been created yet).
+
+    Returns the list of CPU devices. If a backend was already initialized the
+    retarget cannot take effect; in that case falls back to whatever devices
+    the default platform offers (matching the pre-round-2 behavior) and the
+    caller's device-count assertion reports the shortfall.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; fall through to whatever exists
+
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        # Backend was initialized before the retarget (flag came too late for
+        # the CPU client). Use the default platform's devices instead.
+        devices = jax.devices()
+    return devices[:n_devices]

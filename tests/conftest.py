@@ -3,22 +3,13 @@
 Multi-device sharding tests run on CPU via
 ``--xla_force_host_platform_device_count`` (SURVEY §4's test strategy).
 ``jax`` may already be imported at interpreter startup (axon tunnel), so the
-platform is switched through ``jax.config`` rather than env vars — this works
-as long as no backend has been initialized yet.
+platform switch goes through ``utils.platform.force_virtual_cpu`` (env vars +
+``jax.config``) — this works as long as no backend has been initialized yet.
 """
 
-import os
+from howtotrainyourmamlpytorch_tpu.utils.platform import force_virtual_cpu
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
