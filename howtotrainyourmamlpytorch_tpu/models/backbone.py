@@ -61,6 +61,13 @@ class BackboneConfig:
     conv_padding: int = 1  # int(bool) like the reference's conv_padding flag
     max_pooling: bool = True
     norm_layer: str = "batch_norm"  # or "layer_norm"
+    # Stage op ordering: "conv_norm" = conv -> norm -> LeakyReLU (the
+    # reference backbone's MetaConvNormLayerReLU,
+    # meta_neural_network_architectures.py:323-433); "norm_conv" = norm of
+    # the stage INPUT -> conv -> LeakyReLU (its unused alternative block
+    # MetaNormLayerConvReLU, :436-539 — normalization features/shapes follow
+    # the input channels).
+    block_order: str = "conv_norm"
     per_step_bn_statistics: bool = False
     num_steps: int = 5  # rows of per-step BN arrays
     enable_inner_loop_optimizable_bn_params: bool = False
@@ -71,9 +78,11 @@ class BackboneConfig:
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
     # Fused Pallas bn+leaky_relu kernel (ops/pallas_fused_norm.py). Its
-    # custom_vjp supports one level of reverse-mode AD: valid for eval,
-    # first-order MAML, and the baselines; second-order paths must keep the
-    # lax batch_norm (callers pass fused=False there).
+    # custom_vjp supports ONE level of reverse-mode AD: valid for MAML eval
+    # (inner grad only) and the GD/matching-nets baselines (single outer
+    # grad). MAML *training* — even first-order — differentiates the inner
+    # value_and_grad again with the outer meta-gradient, so MAML passes
+    # fused=False on every train path (models/maml.py outer_grad flag).
     use_pallas_fused_norm: bool = False
 
     @property
@@ -125,10 +134,11 @@ class VGGBackbone:
         (``meta_neural_network_architectures.py:62-66,115-118,177-198``).
         """
         cfg = self.cfg
+        if cfg.block_order not in ("conv_norm", "norm_conv"):
+            raise ValueError(f"unknown block_order {cfg.block_order!r}")
         params: Params = {}
         bn_state: Params = {}
         in_ch = cfg.image_channels
-        spatial = [(cfg.image_height, cfg.image_width)] + cfg.stage_spatial_shapes()
         keys = jax.random.split(key, cfg.num_stages + 1)
 
         for i in range(cfg.num_stages):
@@ -142,28 +152,33 @@ class VGGBackbone:
                     "bias": jnp.zeros((cfg.num_filters,), dtype),
                 }
             }
+            # norm_conv normalizes the stage INPUT (C7's ordering,
+            # meta_neural_network_architectures.py:474-487), so the feature
+            # count/shape follows in_ch rather than the conv output.
+            norm_ch = in_ch if cfg.block_order == "norm_conv" else cfg.num_filters
             if cfg.norm_layer == "batch_norm":
                 affine_shape = (
-                    (cfg.num_steps, cfg.num_filters)
+                    (cfg.num_steps, norm_ch)
                     if cfg.per_step_affine
-                    else (cfg.num_filters,)
+                    else (norm_ch,)
                 )
                 stage["norm"] = {
                     "gamma": jnp.ones(affine_shape, dtype),
                     "beta": jnp.zeros(affine_shape, dtype),
                 }
                 bn_state[f"conv{i}"] = init_batch_norm_state(
-                    cfg.num_filters,
+                    norm_ch,
                     cfg.num_steps if cfg.per_step_bn_statistics else None,
                     dtype,
                 )
             elif cfg.norm_layer == "layer_norm":
                 # Normalized shape is the full (C, H, W) activation like the
-                # reference (``meta_neural_network_architectures.py:379``).
-                h, w = self._pre_pool_shape(i)
+                # reference (``meta_neural_network_architectures.py:379``):
+                # conv output for conv_norm, stage input for norm_conv.
+                h, w = self._norm_spatial_shape(i)
                 stage["norm"] = {
-                    "weight": jnp.ones((cfg.num_filters, h, w), dtype),
-                    "bias": jnp.zeros((cfg.num_filters, h, w), dtype),
+                    "weight": jnp.ones((norm_ch, h, w), dtype),
+                    "bias": jnp.zeros((norm_ch, h, w), dtype),
                 }
             params[f"conv{i}"] = stage
             in_ch = cfg.num_filters
@@ -184,6 +199,16 @@ class VGGBackbone:
             if cfg.max_pooling and i < stage:
                 h, w = h // 2, w // 2
         return h, w
+
+    def _norm_spatial_shape(self, stage: int) -> tuple[int, int]:
+        """(H, W) the normalization sees: the conv output for conv_norm, the
+        stage input for norm_conv."""
+        cfg = self.cfg
+        if cfg.block_order == "conv_norm":
+            return self._pre_pool_shape(stage)
+        if stage == 0:
+            return cfg.image_height, cfg.image_width
+        return cfg.stage_spatial_shapes()[stage - 1]
 
     def apply(
         self,
@@ -211,18 +236,27 @@ class VGGBackbone:
         """
         del training
         cfg = self.cfg
-        use_fused = cfg.use_pallas_fused_norm if fused is None else fused
+        # The fused kernel covers the adjacent bn+leaky_relu pair, which only
+        # exists in the conv_norm ordering.
+        use_fused = (
+            (cfg.use_pallas_fused_norm if fused is None else fused)
+            and cfg.block_order == "conv_norm"
+        )
         new_bn_state: Params = {}
         out = x
-        for i in range(cfg.num_stages):
-            stage = params[f"conv{i}"]
-            out = conv2d(
+
+        def run_conv(out, stage):
+            return conv2d(
                 out,
                 stage["conv"]["weight"],
                 stage["conv"]["bias"],
                 stride=cfg.conv_stride,
                 padding=cfg.conv_padding,
             )
+
+        def run_norm(out, stage, i):
+            """Normalization (+ activation when fused). Returns (out, done)
+            where done means the activation is already applied."""
             if cfg.norm_layer == "batch_norm":
                 if use_fused:
                     out, new_bn_state[f"conv{i}"] = self._fused_norm_act(
@@ -232,24 +266,35 @@ class VGGBackbone:
                         bn_state[f"conv{i}"],
                         step,
                     )
-                else:
-                    out, new_bn_state[f"conv{i}"] = batch_norm(
-                        out,
-                        stage["norm"]["gamma"],
-                        stage["norm"]["beta"],
-                        bn_state[f"conv{i}"],
-                        step,
-                        momentum=cfg.bn_momentum,
-                        eps=cfg.bn_eps,
-                    )
-                    out = jax.nn.leaky_relu(out, negative_slope=0.01)
+                    return out, True
+                out, new_bn_state[f"conv{i}"] = batch_norm(
+                    out,
+                    stage["norm"]["gamma"],
+                    stage["norm"]["beta"],
+                    bn_state[f"conv{i}"],
+                    step,
+                    momentum=cfg.bn_momentum,
+                    eps=cfg.bn_eps,
+                )
             elif cfg.norm_layer == "layer_norm":
                 out = layer_norm(
                     out, stage["norm"]["weight"], stage["norm"]["bias"], eps=cfg.bn_eps
                 )
+            return out, False
+
+        for i in range(cfg.num_stages):
+            stage = params[f"conv{i}"]
+            if cfg.block_order == "norm_conv":
+                # C7 ordering: norm(stage input) -> conv -> LeakyReLU
+                # (meta_neural_network_architectures.py:525-533).
+                out, _ = run_norm(out, stage, i)
+                out = run_conv(out, stage)
                 out = jax.nn.leaky_relu(out, negative_slope=0.01)
             else:
-                out = jax.nn.leaky_relu(out, negative_slope=0.01)
+                out = run_conv(out, stage)
+                out, activated = run_norm(out, stage, i)
+                if not activated:
+                    out = jax.nn.leaky_relu(out, negative_slope=0.01)
             if cfg.max_pooling:
                 out = max_pool2d(out, 2, 2)
 

@@ -189,17 +189,31 @@ class MAMLFewShotLearner(CheckpointableLearner):
         self.current_epoch = 0
 
         self._jit_kwargs = {}
+        self._inner_grad_anchor = None
         if mesh is not None:
-            from ..parallel.mesh import batch_sharding, replicated
-
-            # State and importance replicated; the task axis of every batch
-            # array sharded over the mesh's data axis ('dp'). XLA inserts the
-            # outer-grad all-reduce over ICI automatically.
-            self._jit_kwargs["in_shardings"] = (
-                replicated(mesh),
-                batch_sharding(mesh),
-                replicated(mesh),
+            from ..parallel.mesh import (
+                DEFAULT_MODEL_AXIS,
+                batch_sharding,
+                mp_grad_anchor,
+                replicated,
             )
+
+            if mesh.shape.get(DEFAULT_MODEL_AXIS, 1) > 1:
+                # Tensor-parallel: theta is laid out by the caller
+                # (parallel/mesh.param_shardings, shard_model=True) and arg
+                # shardings drive the layout — pinning in_shardings would
+                # force theta replicated. Per-step inner gradients are
+                # re-anchored mp-replicated (see mp_grad_anchor).
+                self._inner_grad_anchor = mp_grad_anchor(mesh)
+            else:
+                # State and importance replicated; the task axis of every
+                # batch array sharded over the mesh's data axis ('dp'). XLA
+                # inserts the outer-grad all-reduce over ICI automatically.
+                self._jit_kwargs["in_shardings"] = (
+                    replicated(mesh),
+                    batch_sharding(mesh),
+                    replicated(mesh),
+                )
 
         # Compiled step variants, keyed by the static flags
         # (second_order, final_only); built lazily so a run only compiles
@@ -249,14 +263,16 @@ class MAMLFewShotLearner(CheckpointableLearner):
                 return state, jax.tree.map(lambda m: m[-1], metrics)
 
             jit_kwargs = {}
-            if self.mesh is not None:
+            # Same sharding policy as the single-step path: pin shardings
+            # only on dp-only meshes (__init__ set in_shardings there); on
+            # mp meshes the caller's theta layout must drive the program.
+            if self.mesh is not None and "in_shardings" in self._jit_kwargs:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 from ..parallel.mesh import DEFAULT_DATA_AXIS, replicated
 
-                # Same sharding rules as the single-step path: the task axis
-                # (second axis here, after the leading K scan axis) over
-                # 'dp', state and importance replicated.
+                # Task axis (second axis here, after the leading K scan
+                # axis) over 'dp', state and importance replicated.
                 jit_kwargs["in_shardings"] = (
                     replicated(self.mesh),
                     NamedSharding(self.mesh, P(None, DEFAULT_DATA_AXIS)),
@@ -411,6 +427,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         second_order: bool,
         pred_step: int | None = None,
         final_only: bool = False,
+        outer_grad: bool = True,
     ):
         """Inner-loop adaptation + per-step target losses for ONE task.
 
@@ -432,10 +449,15 @@ class MAMLFewShotLearner(CheckpointableLearner):
         x_target = x_target.astype(compute_dtype)
         if final_only:
             assert pred_step is None or pred_step == num_steps - 1
-        # The fused Pallas norm kernel's custom_vjp supports one level of
-        # reverse-mode AD — fine for first-order variants (incl. eval), not
-        # for reverse-over-reverse; second-order keeps the lax path.
-        fused = backbone.cfg.use_pallas_fused_norm and not second_order
+        # The fused Pallas norm kernel's custom_vjp supports ONE level of
+        # reverse-mode AD. The support forward already sits under the inner
+        # ``value_and_grad``, so taking the outer meta-gradient over it —
+        # even first-order, via the BN-state/fast-weight carry — is
+        # reverse-over-reverse and fails to linearize. Fused therefore only
+        # when no outer grad is taken: evaluation here, and the GD /
+        # matching-nets baselines, whose single ``value_and_grad`` calls
+        # ``backbone.apply`` with the config default directly.
+        fused = backbone.cfg.use_pallas_fused_norm and not outer_grad
 
         def step_fn(carry, step):
             fast, bn = carry
@@ -449,6 +471,8 @@ class MAMLFewShotLearner(CheckpointableLearner):
             (s_loss, bn1), grads = jax.value_and_grad(support_loss_fn, has_aux=True)(
                 fast
             )
+            if self._inner_grad_anchor is not None:
+                grads = self._inner_grad_anchor(grads)
             if not second_order:
                 grads = lax.stop_gradient(grads)
             fast = lslr_update(fast, grads, lslr, step)
@@ -510,6 +534,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         second_order,
         pred_step: int | None = None,
         final_only: bool = False,
+        outer_grad: bool = True,
     ):
         xs, xt, ys, yt = batch  # (B, N*K, C, H, W), ..., (B, N*K), (B, N*T)
         per_task = functools.partial(
@@ -518,6 +543,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
             second_order=second_order,
             pred_step=pred_step,
             final_only=final_only,
+            outer_grad=outer_grad,
         )
         weighted, aux = jax.vmap(
             per_task, in_axes=(None, None, None, 0, 0, 0, 0, None)
@@ -534,6 +560,12 @@ class MAMLFewShotLearner(CheckpointableLearner):
             self.cfg.number_of_training_steps_per_iter, second_order,
             None, final_only,
         )
+        if self._inner_grad_anchor is not None:
+            # mp meshes: the outer grads feed Adam updates of mp-sharded
+            # theta; without the anchor that layout back-propagates into the
+            # meta-gradient transpose convs and trips the same GSPMD CHECK
+            # (see parallel/mesh.mp_grad_anchor).
+            grads = self._inner_grad_anchor(grads)
         updates, opt_state = self.tx.update(grads, state.opt_state, outer)
         outer = optax.apply_updates(outer, updates)
         # Running stats evolved per task in parallel; mean-reduce across tasks.
@@ -568,6 +600,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
             outer, state.bn_state, batch, importance,
             cfg.number_of_evaluation_steps_per_iter, False,
             None if final_only else pred_step, final_only,
+            outer_grad=False,
         )
         metrics = dict(loss=loss, accuracy=jnp.mean(aux["accuracy"]))
         return metrics, aux["logits"]
@@ -642,6 +675,11 @@ class MAMLFewShotLearner(CheckpointableLearner):
         # The eval target loss sits at the *training* final-step index
         # (few_shot_learning_system.py:239); when that coincides with the
         # last eval step (the usual config) the final-only variant applies.
+        # DOCUMENTED DIVERGENCE (permissive by choice): for eval_steps
+        # strictly below train_steps the reference's loss condition never
+        # fires and it crashes on an empty loss list; here the last eval
+        # step's target loss is reported instead. All shipped configs use
+        # eval_steps == train_steps.
         final_only = (
             cfg.number_of_evaluation_steps_per_iter
             <= cfg.number_of_training_steps_per_iter

@@ -17,10 +17,13 @@ to the fp32 (8, 128) tile.
 Differentiation: exposed via ``jax.custom_vjp`` with the backward pass as a
 second Pallas kernel (standard batch-norm backward through the batch
 statistics, fused with the LeakyReLU mask). ``custom_vjp`` supports ONE
-level of reverse-mode AD — exactly what every first-order path needs (eval,
-first-order MAML, the GD and matching-nets baselines). Second-order MAML
-keeps the pure-lax ``ops/norm.batch_norm`` path, which XLA differentiates
-twice natively; the backbone selects per-path (``models/backbone.py``).
+level of reverse-mode AD — enough for MAML evaluation (the inner-loop
+``value_and_grad`` is the only differentiation) and for the GD and
+matching-nets baselines (one outer grad). MAML *training* — second order
+or first — takes the outer meta-gradient over the inner ``value_and_grad``,
+which is reverse-over-reverse; those paths keep the pure-lax
+``ops/norm.batch_norm``, which XLA differentiates natively to any order
+(``models/maml.py`` selects per-path via its ``outer_grad`` flag).
 
 Numerics: statistics and normalization are computed in fp32 regardless of
 input dtype (bf16-safe), matching ``ops/norm.batch_norm``.

@@ -73,6 +73,38 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def mp_grad_anchor(mesh: Mesh):
+    """Inner-loop gradient anchor for tensor-parallel (``mp``) training.
+
+    With conv weights sharded over ``mp`` out-channels, differentiating the
+    LSLR fast-weight update ``w - lr[step] * g`` a second time (the outer
+    meta-gradient's ``d/d lslr`` transpose) produces an HLO that aborts the
+    GSPMD conv partitioner (``convolution_handler.cc:832`` CHECK, observed
+    on jax 0.9.0 CPU and unfixable from the spec side — anchoring the grads
+    to the parameters' own mp shardings still crashes). Re-anchoring each
+    per-step inner gradient tree to mp-replicated sidesteps the bug: the
+    initial forward/backward and the outer params + Adam moments (the
+    dominant memory) stay mp-sharded, while the small per-task fast weights
+    ride replicated — an acceptable layout for backbone-scale inner loops.
+
+    The returned callable runs INSIDE the per-task function (under the task
+    vmap), so the specs mention no mesh axes: the hidden task axis keeps
+    carrying ``dp``.
+    """
+    if mesh.shape[DEFAULT_MODEL_AXIS] == 1:
+        return None
+
+    def anchor(grads: Any) -> Any:
+        return jax.tree.map(
+            lambda g: jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, P(*([None] * g.ndim)))
+            ),
+            grads,
+        )
+
+    return anchor
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shards the leading (task) axis of batch arrays over ``dp``."""
     return NamedSharding(mesh, P(DEFAULT_DATA_AXIS))

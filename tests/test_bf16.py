@@ -1,0 +1,71 @@
+"""bf16 compute path (VERDICT r1 item 10).
+
+``compute_dtype="bfloat16"`` runs the backbone in bf16 (MXU-native) while
+parameters and BN statistics stay fp32 (``models/maml.py:95-99``,
+``ops/norm.py`` fp32 stats). The toy task must still train to high
+accuracy — bf16's ~3 decimal digits are plenty for this net."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+
+
+def _cfg(dtype):
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2, num_filters=8, per_step_bn_statistics=True,
+            num_steps=2, num_classes=5, image_height=8, image_width=8,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        compute_dtype=dtype,
+    )
+
+
+def _batches(rng, n_iters, b=4):
+    protos = rng.randn(5, 1, 8, 8).astype(np.float32)
+    out = []
+    for _ in range(n_iters):
+        xs = np.stack(
+            [protos + 0.3 * rng.randn(5, 1, 8, 8).astype(np.float32)
+             for _ in range(b)]
+        )[:, :, None]
+        ys = np.tile(np.arange(5)[None, :, None], (b, 1, 1))
+        out.append((xs, xs.copy(), ys, ys.copy()))
+    return out
+
+
+def test_bf16_trains_to_accuracy(rng):
+    learner = MAMLFewShotLearner(_cfg("bfloat16"))
+    state = learner.init_state(jax.random.PRNGKey(0))
+    # Master weights stay fp32.
+    for leaf in jax.tree.leaves(state.theta):
+        assert leaf.dtype == jnp.float32
+    for batch in _batches(rng, 15):
+        state, losses = learner.run_train_iter(state, batch, epoch=0)
+    assert np.isfinite(float(losses["loss"]))
+    assert float(losses["accuracy"]) > 0.9
+    # BN running stats stayed fp32 and finite.
+    for leaf in jax.tree.leaves(state.bn_state):
+        assert leaf.dtype == jnp.float32
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_bf16_eval_close_to_fp32(rng):
+    """Same init, one eval episode: bf16 metrics track fp32 within bf16
+    tolerance."""
+    a = MAMLFewShotLearner(_cfg("float32"))
+    b = MAMLFewShotLearner(_cfg("bfloat16"))
+    sa = a.init_state(jax.random.PRNGKey(7))
+    sb = b.init_state(jax.random.PRNGKey(7))
+    batch = _batches(rng, 1)[0]
+    _, la, _ = a.run_validation_iter(sa, batch)
+    _, lb, _ = b.run_validation_iter(sb, batch)
+    np.testing.assert_allclose(float(la["loss"]), float(lb["loss"]),
+                               rtol=0.1, atol=0.05)

@@ -73,40 +73,60 @@ def test_bf16_input_fp32_stats(rng):
                                rtol=0.05, atol=0.05)
 
 
-def test_fused_backbone_first_order_maml_matches_lax(rng):
-    """First-order MAML trains identically (within fp tolerance) with the
-    fused Pallas norm path and the lax path."""
+def _make_maml(fused, second_order=False):
     from howtotrainyourmamlpytorch_tpu.models import (
         BackboneConfig,
         MAMLConfig,
         MAMLFewShotLearner,
     )
 
-    def make(fused):
-        cfg = MAMLConfig(
-            backbone=BackboneConfig(
-                num_stages=2, num_filters=4, per_step_bn_statistics=True,
-                num_steps=2, num_classes=5, image_height=8, image_width=8,
-                use_pallas_fused_norm=fused,
-            ),
-            number_of_training_steps_per_iter=2,
-            number_of_evaluation_steps_per_iter=2,
-            second_order=False,
-        )
-        learner = MAMLFewShotLearner(cfg)
-        return learner, learner.init_state(jax.random.PRNGKey(5))
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2, num_filters=4, per_step_bn_statistics=True,
+            num_steps=2, num_classes=5, image_height=8, image_width=8,
+            use_pallas_fused_norm=fused,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=second_order,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    return learner, learner.init_state(jax.random.PRNGKey(5))
 
+
+def _episode_batch(rng):
     xs = rng.rand(2, 5, 1, 1, 8, 8).astype(np.float32)
     ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1))
-    batch = (xs, xs.copy(), ys, ys.copy())
+    return (xs, xs.copy(), ys, ys.copy())
 
-    la, sa = make(False)
-    lb, sb = make(True)
+
+def test_fused_maml_eval_matches_lax(rng):
+    """MAML evaluation — the path that enables the fused kernel (one level
+    of reverse AD: the inner value_and_grad) — matches the lax path."""
+    batch = _episode_batch(rng)
+    la, sa = _make_maml(False)
+    lb, sb = _make_maml(True)
+    _, ma, logits_a = la.run_validation_iter(sa, batch)
+    _, mb, logits_b = lb.run_validation_iter(sb, batch)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b),
+                               rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("second_order", [False, True])
+def test_fused_config_trains_like_lax(rng, second_order):
+    """With use_pallas_fused_norm=True, MAML train steps auto-select the lax
+    path (the outer meta-gradient cannot differentiate the custom_vjp a
+    second time), so training must both run and match the lax config
+    exactly."""
+    batch = _episode_batch(rng)
+    la, sa = _make_maml(False, second_order)
+    lb, sb = _make_maml(True, second_order)
     for _ in range(2):
         sa, ma = la.run_train_iter(sa, batch, epoch=20)
         sb, mb = lb.run_train_iter(sb, batch, epoch=20)
     np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
-                               rtol=1e-3, atol=1e-4)
+                               rtol=1e-6, atol=0)
     for a, b in zip(jax.tree.leaves(sa.theta), jax.tree.leaves(sb.theta)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=5e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
