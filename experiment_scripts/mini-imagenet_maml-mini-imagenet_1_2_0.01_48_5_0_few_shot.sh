@@ -1,0 +1,8 @@
+#!/bin/sh
+# Runner template: $execution_script$ / $experiment_config$ are filled by
+# generate_scripts.py. Arg 1 optionally selects a device ordinal.
+export DEVICE_ID=$1
+echo $DEVICE_ID
+cd ..
+export DATASET_DIR="datasets/"
+python train_maml_system.py --name_of_args_json_file experiment_config/mini-imagenet_maml-mini-imagenet_1_2_0.01_48_5_0.json --gpu_to_use $DEVICE_ID

@@ -13,8 +13,12 @@ Capability parity with the reference's ``FewShotLearningDatasetParallel``
 * per-episode deterministic RNG with the reference's exact call order
   (``data.py:478-524``): ``RandomState(seed)`` -> ``choice`` of N classes
   (no replacement) -> ``shuffle`` -> per-class rotation ``randint(0, 4)``
-  -> per-class ``choice`` of K+T sample indices — so fixed-seed episode
-  streams match the reference bit for bit;
+  -> per-class ``choice`` of K+T sample indices — so fixed-seed
+  class/sample/rotation selection matches the reference bit for bit (see
+  tests/test_golden_episodes.py). Stochastic augmentation draws (cifar
+  crop/flip) come from a separate stream forked from the episode seed —
+  the reference draws those from global torch RNG, so its augmented pixel
+  streams are not reproducible at all; selection parity is the invariant;
 * derived split seeds: ``RandomState(args.X_seed).randint(1, 999999)`` with
   the test seed equal to the val seed (``data.py:131-142`` — a documented
   reference quirk, SURVEY §5);
@@ -261,6 +265,12 @@ class FewShotLearningDataset:
         support_labels (N,K), target_labels (N,T), seed)``.
         """
         rng = np.random.RandomState(seed)
+        # Stochastic augmentation (cifar crop/flip) draws from a SEPARATE
+        # stream forked from the episode seed: the reference's torchvision
+        # transforms consume global/torch RNG, not the episode RandomState,
+        # so feeding `rng` to them would desynchronize class/sample
+        # selection from the reference on those datasets (ADVICE r1).
+        aug_rng = np.random.RandomState((seed + 0x5EED) % (2**32))
         size_dict = self.dataset_size_dict[dataset_name]
         selected_classes = rng.choice(
             list(size_dict.keys()), size=self.num_classes_per_set, replace=False
@@ -293,7 +303,7 @@ class FewShotLearningDataset:
                     augment_bool=augment_images,
                     args=self.args,
                     dataset_name=self.dataset_name,
-                    rng=rng,
+                    rng=aug_rng,
                 )
                 class_image_samples.append(x)
                 class_labels.append(class_to_episode_label[class_entry])

@@ -2,12 +2,20 @@
 templates.
 
 Capability parity with the reference's
-``script_generation_tools/generate_configs.py`` (``:29-136``): for every
-(seed x dataset x shots x ways x batch x inner-lr x filters) combination,
-fill the matching ``experiment_template_config/*.json`` template by
-``$var$`` substitution and write it to ``experiment_config/``, named
-``<template>-<dataset>_<shots>_<batch>_<innerlr>_<filters>_<ways...>_<seed>
-.json``.
+``script_generation_tools/generate_configs.py`` (``:29-136``), with
+reference-identical output filenames:
+``<template>-<dataset>_<shots>_<batch>_<innerlr>_<filters>_<ways>_<seed>.json``
+(the sweep-tag field order is the reference's ``hyper_config`` namedtuple
+order). The ``omniglot_gradient-descent`` / ``omniglot_matching-nets``
+templates reproduce the reference's two hand-added baseline configs: they
+are emitted only for the (1-shot, 5-way, seed 1) point and carry the
+model-tagged experiment names of the bundled runs (``omniglot_gd_*``,
+``omniglot_matching_nets_*``).
+
+Documented divergence: the reference hand-edited
+``omniglot_maml-omniglot_1_8_0.1_64_5_1.json``'s experiment_name to
+``omniglot_maml_1_8_0.1_64_5_1`` after generating; regenerating with its own
+generator (or this one) yields ``omniglot_1_8_0.1_64_5_1``.
 """
 
 from __future__ import annotations
@@ -16,25 +24,34 @@ import os
 
 SEED_LIST = [0, 1, 2]
 
-# Per-dataset sweep ranges (the paper's experiment grid).
+# Per-dataset sweep ranges (the paper's experiment grid), field order as the
+# reference's hyper_config namedtuple (generate_configs.py:29-36).
 HYPER = {
     "omniglot": dict(
         num_samples_per_class_range=[1, 5],
-        num_classes_range=[20, 5],
         batch_size_range=[8],
         init_inner_loop_learning_rate_range=[0.1],
         num_filters=[64],
+        num_classes_range=[20, 5],
         target_samples_per_class=1,
     ),
     "mini-imagenet": dict(
         num_samples_per_class_range=[1, 5],
-        num_classes_range=[5],
         batch_size_range=[2],
         init_inner_loop_learning_rate_range=[0.01],
         num_filters=[48],
+        num_classes_range=[5],
         target_samples_per_class=15,
     ),
 }
+
+# The reference's two baseline configs exist only at this sweep point
+# (experiment_config/omniglot_{gradient-descent,matching-nets}-*.json).
+BASELINE_TEMPLATES = {
+    "omniglot_gradient-descent": "gd",
+    "omniglot_matching-nets": "matching_nets",
+}
+BASELINE_POINT = dict(shots=1, ways=5, seed=1)
 
 TEMPLATE_DIR = os.path.join(os.path.dirname(__file__), "..",
                             "experiment_template_config")
@@ -44,10 +61,10 @@ TARGET_DIR = os.path.join(os.path.dirname(__file__), "..", "experiment_config")
 def sweep(dataset_name: str):
     h = HYPER[dataset_name]
     for shots in h["num_samples_per_class_range"]:
-        for ways in h["num_classes_range"]:
-            for batch in h["batch_size_range"]:
-                for inner_lr in h["init_inner_loop_learning_rate_range"]:
-                    for filters in h["num_filters"]:
+        for batch in h["batch_size_range"]:
+            for inner_lr in h["init_inner_loop_learning_rate_range"]:
+                for filters in h["num_filters"]:
+                    for ways in h["num_classes_range"]:
                         yield dict(
                             dataset_name=dataset_name,
                             num_classes=ways,
@@ -73,9 +90,11 @@ def fill_template(text: str, values: dict) -> str:
 
 def main() -> None:
     os.makedirs(TARGET_DIR, exist_ok=True)
+    count = 0
     for template_file in sorted(os.listdir(TEMPLATE_DIR)):
         if not template_file.endswith(".json"):
             continue
+        template_name = template_file.replace(".json", "")
         dataset_name = (
             "omniglot" if "omniglot" in template_file else "mini-imagenet"
         )
@@ -86,24 +105,33 @@ def main() -> None:
                 values = dict(values)
                 values["train_seed"] = seed
                 values["val_seed"] = 0
+                # Reference sweep-tag field order (hyper_config order).
                 sweep_tag = "_".join(
                     str(values[k])
                     for k in (
-                        "num_classes", "samples_per_class", "batch_size",
+                        "samples_per_class", "batch_size",
                         "init_inner_loop_learning_rate", "num_filters",
-                        "train_update_steps",
+                        "num_classes",
                     )
                 )
-                values["experiment_name"] = (
-                    f"{dataset_name}_{sweep_tag}_{seed}"
-                )
-                out_name = "{}-{}.json".format(
-                    template_file.replace(".json", ""),
-                    values["experiment_name"],
-                )
+                run_name = f"{dataset_name}_{sweep_tag}_{seed}"
+                values["experiment_name"] = run_name
+                if template_name in BASELINE_TEMPLATES:
+                    if not (
+                        values["samples_per_class"] == BASELINE_POINT["shots"]
+                        and values["num_classes"] == BASELINE_POINT["ways"]
+                        and seed == BASELINE_POINT["seed"]
+                    ):
+                        continue
+                    tag = BASELINE_TEMPLATES[template_name]
+                    values[f"experiment_name_{tag}"] = (
+                        f"{dataset_name}_{tag}_{sweep_tag}_{seed}"
+                    )
+                out_name = f"{template_name}-{run_name}.json"
                 with open(os.path.join(TARGET_DIR, out_name), "w") as f:
                     f.write(fill_template(template, values))
-    print("configs written to", os.path.abspath(TARGET_DIR))
+                count += 1
+    print(f"{count} configs written to", os.path.abspath(TARGET_DIR))
 
 
 if __name__ == "__main__":

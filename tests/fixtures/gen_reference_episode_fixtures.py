@@ -1,0 +1,190 @@
+"""Generate golden fixtures by EXECUTING the reference sampler.
+
+Runs the actual ``FewShotLearningDatasetParallel.get_set`` /
+``load_dataset`` code from the read-only reference checkout
+(``/root/reference/data.py:478-524,169-211``) against a synthetic class
+tree, recording every RNG-driven decision — selected classes, shuffled
+order, per-class rotation ``k``, per-class sample indices, episode label
+matrices, and the ratio-split class partition — into
+``reference_episodes.json``. ``tests/test_golden_episodes.py`` then asserts
+the repo's sampler reproduces the recordings bit for bit.
+
+Requires the reference checkout (it is imported, never copied); the fixture
+JSON is committed so CI does not need it. torchvision is absent from the
+environment, so it is stubbed before import — the stubbed pieces
+(transforms) are never exercised: image loading and augmentation are
+monkeypatched to pure recorders, which leaves exactly the RNG call order
+under test.
+
+Usage: python tests/fixtures/gen_reference_episode_fixtures.py [ref_path]
+"""
+
+import json
+import os
+import sys
+import types
+
+REF = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "reference_episodes.json")
+
+# --- import the reference data module with unused deps stubbed -----------
+tv = types.ModuleType("torchvision")
+tv.transforms = types.ModuleType("torchvision.transforms")
+tv.transforms.Compose = lambda *a, **k: None
+tv.transforms.ToTensor = lambda *a, **k: None
+tv.transforms.Normalize = lambda *a, **k: None
+tv.transforms.RandomCrop = lambda *a, **k: None
+tv.transforms.RandomHorizontalFlip = lambda *a, **k: None
+sys.modules["torchvision"] = tv
+sys.modules["torchvision.transforms"] = tv.transforms
+
+# data.py does `from utils.parser_utils import get_args` at module level,
+# which parses argv; give it an importable stub instead.
+utils_pkg = types.ModuleType("utils")
+parser_stub = types.ModuleType("utils.parser_utils")
+parser_stub.get_args = lambda *a, **k: None
+utils_pkg.parser_utils = parser_stub
+sys.modules["utils"] = utils_pkg
+sys.modules["utils.parser_utils"] = parser_stub
+
+sys.path.insert(0, REF)
+import importlib
+
+ref_data = importlib.import_module("data")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+Cls = ref_data.FewShotLearningDatasetParallel
+
+
+def make_stub(n_classes, samples_per_class, num_classes_per_set,
+              num_samples_per_class, num_target_samples):
+    """A bare instance with only the attributes get_set touches."""
+    self = Cls.__new__(Cls)
+    self.num_classes_per_set = num_classes_per_set
+    self.num_samples_per_class = num_samples_per_class
+    self.num_target_samples = num_target_samples
+    self.image_channel = 1
+    self.dataset_name = "omniglot_dataset"
+    self.args = types.SimpleNamespace()
+    keys = [f"c{i:03d}" for i in range(n_classes)]
+    self.datasets = {
+        "train": {k: [f"{k}/s{j:02d}" for j in range(samples_per_class)]
+                  for k in keys}
+    }
+    self.dataset_size_dict = {
+        "train": {k: samples_per_class for k in keys}
+    }
+    return self
+
+
+def record_episode(stub, seed):
+    """Run the REFERENCE get_set, recording loads and augmentation ks."""
+    loads = []
+    ks = []
+
+    def fake_load_batch(batch_image_paths):
+        loads.append(batch_image_paths[0])
+        return torch.zeros(1, 1, 1, 1)
+
+    def fake_augment_image(image, k, channels, augment_bool, dataset_name,
+                           args):
+        ks.append(int(k))
+        return image[0]
+
+    stub.load_batch = fake_load_batch
+    orig = ref_data.augment_image
+    ref_data.augment_image = fake_augment_image
+    try:
+        _xs, _xt, ys, yt, out_seed = Cls.get_set(
+            stub, "train", seed=seed, augment_images=False
+        )
+    finally:
+        ref_data.augment_image = orig
+
+    n = stub.num_classes_per_set
+    per_class = stub.num_samples_per_class + stub.num_target_samples
+    classes_in_order = []
+    samples = []
+    for ci in range(n):
+        chunk = loads[ci * per_class:(ci + 1) * per_class]
+        cls_names = {p.split("/")[0] for p in chunk}
+        assert len(cls_names) == 1
+        classes_in_order.append(chunk[0].split("/")[0])
+        samples.append([int(p.split("/s")[1]) for p in chunk])
+    class_ks = ks[::per_class]
+    assert ks == [k for k in class_ks for _ in range(per_class)]
+    return {
+        "seed": seed,
+        "selected_classes": classes_in_order,
+        "rotation_k": class_ks,
+        "sample_indices": samples,
+        "support_labels": np.asarray(ys).astype(int).tolist(),
+        "target_labels": np.asarray(yt).astype(int).tolist(),
+        "returned_seed": int(out_seed),
+    }
+
+
+def record_split(n_classes, val_seed_arg, split):
+    """Run the REFERENCE load_dataset ratio-split branch on synthetic keys,
+    plus the derived-seed math of __init__ (data.py:132-142)."""
+    self = Cls.__new__(Cls)
+    val_seed = np.random.RandomState(seed=val_seed_arg).randint(1, 999999)
+    self.seed = {"val": int(val_seed)}
+    self.args = types.SimpleNamespace(
+        sets_are_pre_split=False, load_into_memory=False
+    )
+    self.train_val_test_split = split
+    keys = [f"c{i:03d}" for i in range(n_classes)]
+    self.load_datapaths = lambda: (
+        {k: [f"{k}/s00"] for k in keys}, {k: k for k in keys}, None
+    )
+    splits = Cls.load_dataset(self)
+    return {
+        "n_classes": n_classes,
+        "val_seed_arg": val_seed_arg,
+        "derived_val_seed": int(val_seed),
+        "split": list(split),
+        "train_classes": list(splits["train"].keys()),
+        "val_classes": list(splits["val"].keys()),
+        "test_classes": list(splits["test"].keys()),
+    }
+
+
+def main():
+    fixture = {"configs": [], "splits": [], "derived_seeds": []}
+    configs = [
+        dict(n_classes=30, samples_per_class=20, num_classes_per_set=5,
+             num_samples_per_class=1, num_target_samples=1),
+        dict(n_classes=30, samples_per_class=20, num_classes_per_set=20,
+             num_samples_per_class=1, num_target_samples=1),
+        dict(n_classes=30, samples_per_class=20, num_classes_per_set=5,
+             num_samples_per_class=5, num_target_samples=2),
+    ]
+    seeds = [0, 1, 7, 104, 12345, 999999]
+    for cfg in configs:
+        stub = make_stub(**cfg)
+        episodes = [record_episode(stub, s) for s in seeds]
+        fixture["configs"].append({"config": cfg, "episodes": episodes})
+
+    fixture["splits"] = [
+        record_split(50, 0, [0.7, 0.15, 0.15]),
+        record_split(50, 104, [0.8, 0.1, 0.1]),
+        record_split(1623, 0, [0.70918861, 0.03080872, 0.26000266]),
+    ]
+    for arg in (0, 104, 12345):
+        fixture["derived_seeds"].append({
+            "arg": arg,
+            "derived": int(np.random.RandomState(seed=arg).randint(1, 999999)),
+        })
+
+    with open(OUT, "w") as f:
+        json.dump(fixture, f, indent=1)
+    n_eps = sum(len(c["episodes"]) for c in fixture["configs"])
+    print(f"wrote {OUT}: {n_eps} episodes, {len(fixture['splits'])} splits")
+
+
+if __name__ == "__main__":
+    main()
