@@ -6,7 +6,10 @@ meta-batch 8, 64 filters, 5 inner steps, second order, per-step BN, MSL
 averages 908.6 s / 500 iters = 0.55 meta-iters/s (BASELINE.md). Synthetic
 episode data isolates device compute, which dominates that number.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+observability extras — "mfu" (model-FLOPs utilization of the compiled
+train program against the chip's bf16 peak) and
+"bf16_meta_iters_per_s" (the compute_dtype="bfloat16" variant).
 """
 
 from __future__ import annotations
@@ -21,36 +24,94 @@ from __graft_entry__ import _episode_batch, _flagship_config
 
 BASELINE_META_ITERS_PER_S = 0.55
 
+# Peak dense-matmul throughput per chip, bf16 (MFU denominator). v5e = 197
+# TFLOP/s; fall back to it for unknown kinds (reported MFU is then an
+# estimate against a v5e-class chip).
+PEAK_FLOPS_BY_KIND = {
+    "TPU v5 lite": 197.4e12,
+    "TPU v5e": 197.4e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
 
-def main() -> None:
+
+def _measure(cfg, repeats=40, K=25):
     from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
 
-    cfg = _flagship_config()
     learner = MAMLFewShotLearner(cfg)
     state = learner.init_state(jax.random.PRNGKey(0))
-    rng = np.random.RandomState(0)
-    batch = _episode_batch(8, cfg, rng)
-
-    # Steady-state regime of the flagship run: second order, past the MSL
-    # horizon (90 of 100 epochs) — epoch 20 selects that compiled variant.
-    # K consecutive meta-updates ride one dispatch (lax.scan iteration
-    # batching, models/maml.py run_train_iters); block_until_ready after
-    # every dispatch group bounds the number by real completion.
-    epoch = 20
-    K = 25
     rng2 = np.random.RandomState(1)
     batches = [_episode_batch(8, cfg, rng2) for _ in range(K)]
+    # Steady-state regime of the flagship run: second order, past the MSL
+    # horizon (90 of 100 epochs) — epoch 20 selects that compiled variant.
+    epoch = 20
     state, _ = learner.run_train_iters(state, batches, epoch=epoch)  # compile
     jax.block_until_ready(state.theta)
 
-    repeats = 40
     t0 = time.perf_counter()
     for _ in range(repeats):
         state, _ = learner.run_train_iters(state, batches, epoch=epoch)
     jax.block_until_ready(state.theta)
     dt = time.perf_counter() - t0
+    return repeats * K / dt, learner, batches, epoch, K
 
-    value = repeats * K / dt
+
+def _flops_per_iter(learner, state_template, batches, epoch, K):
+    """FLOPs of one meta-iteration from the compiled program's own cost
+    analysis (falls back to None off-TPU or if the backend omits flops).
+    Lowers the SAME program variant the measurement ran (the flags the
+    learner derives for this epoch), so the MFU numerator matches."""
+    try:
+        import numpy as _np
+
+        prepared = [learner._prepare_batch(b) for b in batches]
+        stacked = tuple(
+            _np.stack([p[i] for p in prepared]) for i in range(4)
+        )
+        cfg = learner.cfg
+        final_only = not (
+            cfg.use_multi_step_loss_optimization
+            and epoch < cfg.multi_step_loss_num_epochs
+        )
+        step = learner._get_multi_train_step(
+            learner._use_second_order(epoch), final_only
+        )
+        cost = step.lower(
+            state_template, stacked,
+            jax.numpy.asarray(learner._train_importance(epoch)),
+        ).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops / K if flops > 0 else None
+    except Exception as exc:  # noqa: BLE001 — observability only
+        print(f"# cost analysis unavailable: {exc}")
+        return None
+
+
+def main() -> None:
+    cfg = _flagship_config()
+    value, learner, batches, epoch, K = _measure(cfg)
+
+    # MFU: measured iters/s x FLOPs/iter / chip peak.
+    mfu = None
+    state_template = learner.init_state(jax.random.PRNGKey(0))
+    flops = _flops_per_iter(learner, state_template, batches, epoch, K)
+    if flops:
+        kind = jax.devices()[0].device_kind
+        peak = next(
+            (v for k, v in PEAK_FLOPS_BY_KIND.items() if k in kind),
+            PEAK_FLOPS_BY_KIND["TPU v5 lite"],
+        )
+        mfu = value * flops / peak
+
+    # bf16 variant (params/stats fp32, backbone compute bf16 on the MXU).
+    import dataclasses
+
+    bf16_cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    bf16_value, *_ = _measure(bf16_cfg, repeats=20)
+
     print(
         json.dumps(
             {
@@ -58,6 +119,8 @@ def main() -> None:
                 "value": round(value, 4),
                 "unit": "meta-iters/s",
                 "vs_baseline": round(value / BASELINE_META_ITERS_PER_S, 2),
+                "mfu": round(mfu, 6) if mfu is not None else None,
+                "bf16_meta_iters_per_s": round(bf16_value, 4),
             }
         )
     )

@@ -116,6 +116,18 @@ class ExperimentBuilder:
         self._use_multi = self.iters_per_dispatch > 1 and hasattr(
             self.model, "run_train_iters"
         )
+        # Observability (SURVEY §5 tracing row — the reference has none):
+        # dispatch-to-dispatch wall times summarized into per-epoch
+        # percentiles, plus an optional jax.profiler trace of the first
+        # profile_num_iters train iterations of this run.
+        self._step_times: list[float] = []
+        self._last_dispatch_t: float | None = None
+        self.profile_trace_path = str(
+            getattr(args, "profile_trace_path", "") or ""
+        )
+        self.profile_num_iters = int(getattr(args, "profile_num_iters", 20) or 20)
+        self._profiling = False
+        self._profiled_iters = 0
 
     # ------------------------------------------------------------------
     # Metric summarization (experiment_builder.py:65-100)
@@ -125,8 +137,12 @@ class ExperimentBuilder:
     def build_summary_dict(total_losses, phase, summary_losses=None):
         if summary_losses is None:
             summary_losses = {}
-        for key in total_losses:
-            values = np.asarray([float(v) for v in total_losses[key]])
+        # One batched device->host fetch for ALL accumulated device scalars:
+        # float()-ing them one by one costs a full tunnel round trip each
+        # (measured ~30 s per epoch at 500 iters x 12 metrics).
+        host_losses = jax.device_get(total_losses)
+        for key in host_losses:
+            values = np.asarray(host_losses[key], dtype=np.float64)
             summary_losses[f"{phase}_{key}_mean"] = np.mean(values)
             summary_losses[f"{phase}_{key}_std"] = np.std(values)
         return summary_losses
@@ -146,6 +162,53 @@ class ExperimentBuilder:
         return z
 
     # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    def _record_dispatch(self, n_iters: int = 1) -> None:
+        """Dispatch-to-dispatch wall time per iteration (the practical
+        steady-state step time; metrics stay lazy so no device sync)."""
+        now = time.perf_counter()
+        if self._last_dispatch_t is not None:
+            self._step_times.extend(
+                [(now - self._last_dispatch_t) / n_iters] * n_iters
+            )
+        self._last_dispatch_t = now
+        self._profile_tick(n_iters)
+
+    def _profile_tick(self, n_iters: int) -> None:
+        if not self.profile_trace_path:
+            return
+        if not self._profiling and self._profiled_iters == 0:
+            jax.profiler.start_trace(self.profile_trace_path)
+            self._profiling = True
+            print("profiler trace started ->", self.profile_trace_path)
+        if self._profiling:
+            self._profiled_iters += n_iters
+            if self._profiled_iters >= self.profile_num_iters:
+                self._stop_profiler()
+
+    def _stop_profiler(self) -> None:
+        """Idempotent; also called from run_experiment's finally so a short
+        or crashing run still flushes the trace file."""
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self.profile_trace_path = ""  # one-shot
+            print("profiler trace stopped")
+
+    def _epoch_step_time_stats(self) -> dict:
+        if not self._step_times:
+            return {}
+        times = np.asarray(self._step_times)
+        self._step_times = []
+        self._last_dispatch_t = None
+        return {
+            "train_step_time_p50": float(np.percentile(times, 50)),
+            "train_step_time_p95": float(np.percentile(times, 95)),
+        }
+
+    # ------------------------------------------------------------------
     # Iterations (experiment_builder.py:102-188)
     # ------------------------------------------------------------------
 
@@ -160,6 +223,7 @@ class ExperimentBuilder:
         self.train_state, losses = self.model.run_train_iter(
             self.train_state, data_batch, epoch=epoch_idx
         )
+        self._record_dispatch()
         # Metrics are device scalars; they are appended UNREAD so the host
         # never blocks on the step it just dispatched (the summary forces
         # them at epoch boundaries). Reading per-iteration here measured an
@@ -183,6 +247,7 @@ class ExperimentBuilder:
         self.train_state, losses = self.model.run_train_iters(
             self.train_state, batches, epoch=epoch_idx
         )
+        self._record_dispatch(len(samples))
         for key, value in losses.items():
             total_losses.setdefault(key, []).append(value)
         current_iter += len(samples)
@@ -322,6 +387,12 @@ class ExperimentBuilder:
     # ------------------------------------------------------------------
 
     def run_experiment(self):
+        try:
+            return self._run_experiment()
+        finally:
+            self._stop_profiler()
+
+    def _run_experiment(self):
         total_iters = int(self.args.total_epochs * self.args.total_iter_per_epoch)
         while (
             self.state["current_iter"] < total_iters
@@ -368,6 +439,7 @@ class ExperimentBuilder:
                     train_losses = self.build_summary_dict(
                         self.total_losses, phase="train"
                     )
+                    train_losses.update(self._epoch_step_time_stats())
                     total_losses = {}
                     num_val_batches = int(
                         self.args.num_evaluation_tasks / self.args.batch_size

@@ -31,8 +31,12 @@ _EXPERIMENT_KEY = "__experiment_state__"
 
 
 def save_checkpoint(filepath: str, state_tree: Tree, experiment_state: dict) -> str:
-    """Writes leaves + experiment state to ``filepath`` (no extension added)."""
-    leaves = jax.tree.leaves(state_tree)
+    """Writes leaves + experiment state to ``filepath`` (no extension added).
+
+    Device arrays are fetched with ONE batched ``jax.device_get`` — per-leaf
+    ``np.asarray`` costs a full device round trip each (~10 s per save
+    through the axon tunnel vs ~0.2 s batched)."""
+    leaves = jax.device_get(jax.tree.leaves(state_tree))
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     arrays[_EXPERIMENT_KEY] = np.frombuffer(
         json.dumps(experiment_state, default=float).encode(), dtype=np.uint8
