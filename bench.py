@@ -15,6 +15,7 @@ train program against the chip's bf16 peak) and
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -60,33 +61,20 @@ def _measure(cfg, repeats=40, K=25):
 def _flops_per_iter(learner, state_template, batches, epoch, K):
     """FLOPs of one meta-iteration from the compiled program's own cost
     analysis (falls back to None off-TPU or if the backend omits flops).
-    Lowers the SAME program variant the measurement ran (the flags the
-    learner derives for this epoch), so the MFU numerator matches."""
+    ``lowered_train_iters`` lowers the SAME program variant the measurement
+    ran, so the MFU numerator matches."""
     try:
-        import numpy as _np
-
-        prepared = [learner._prepare_batch(b) for b in batches]
-        stacked = tuple(
-            _np.stack([p[i] for p in prepared]) for i in range(4)
+        cost = (
+            learner.lowered_train_iters(state_template, batches, epoch)
+            .compile()
+            .cost_analysis()
         )
-        cfg = learner.cfg
-        final_only = not (
-            cfg.use_multi_step_loss_optimization
-            and epoch < cfg.multi_step_loss_num_epochs
-        )
-        step = learner._get_multi_train_step(
-            learner._use_second_order(epoch), final_only
-        )
-        cost = step.lower(
-            state_template, stacked,
-            jax.numpy.asarray(learner._train_importance(epoch)),
-        ).compile().cost_analysis()
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
         return flops / K if flops > 0 else None
     except Exception as exc:  # noqa: BLE001 — observability only
-        print(f"# cost analysis unavailable: {exc}")
+        print(f"# cost analysis unavailable: {exc}", file=sys.stderr)
         return None
 
 

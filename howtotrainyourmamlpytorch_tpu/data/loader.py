@@ -71,18 +71,35 @@ class MetaLearningSystemDataLoader:
             np.asarray(seeds),
         )
 
-    def _iter_batches(self, length: int, prefetch: int = 2):
+    def _iter_batches(self, set_name: str, seed_base: int, augment: bool,
+                      length: int, prefetch: int = 2):
         """Yields collated batches of ``global_batch`` episodes, synthesized
         by the thread pool and prefetched ``prefetch`` batches ahead.
-        ``drop_last=True`` like the reference."""
+        ``drop_last=True`` like the reference.
+
+        ``set_name``/``seed_base``/``augment`` are SNAPSHOTS taken at
+        generator creation and passed explicitly to ``get_set``. The torch
+        DataLoader gets this isolation for free — its worker processes fork
+        with a frozen copy of the dataset — but here the synthesis pool
+        shares one dataset object, and a validation epoch interleaved into a
+        live training generator mutates ``current_set_name``/
+        ``augment_images`` (``switch_set``/``set_augmentation``). Reading
+        those at synthesis time made every post-val-epoch training batch an
+        unaugmented val-split episode, silently training on (and massively
+        overfitting) the 50-class val split."""
         n_batches = length // self.global_batch
         out: queue.Queue = queue.Queue(maxsize=prefetch)
         sentinel = object()
 
+        def synthesize(idx: int):
+            return self.dataset.get_set(
+                set_name, seed=seed_base + idx, augment_images=augment
+            )
+
         def produce():
             for b in range(n_batches):
                 idxs = range(b * self.global_batch, (b + 1) * self.global_batch)
-                episodes = list(self._pool.map(self.dataset.__getitem__, idxs))
+                episodes = list(self._pool.map(synthesize, idxs))
                 out.put(self._collate(episodes))
             out.put(sentinel)
 
@@ -107,7 +124,10 @@ class MetaLearningSystemDataLoader:
         )
         self.dataset.set_augmentation(augment_images=augment_images)
         self.total_train_iters_produced += self.global_batch
-        yield from self._iter_batches(self.dataset.data_length["train"])
+        yield from self._iter_batches(
+            "train", int(self.dataset.seed["train"]), augment_images,
+            self.dataset.data_length["train"],
+        )
 
     def get_val_batches(self, total_batches: int = -1, augment_images: bool = False):
         """Validation batches from the fixed val seed (``data.py:607-620``)."""
@@ -117,7 +137,10 @@ class MetaLearningSystemDataLoader:
             self.dataset.data_length["val"] = total_batches * self.batch_size
         self.dataset.switch_set(set_name="val")
         self.dataset.set_augmentation(augment_images=augment_images)
-        yield from self._iter_batches(self.dataset.data_length["val"])
+        yield from self._iter_batches(
+            "val", int(self.dataset.seed["val"]), augment_images,
+            self.dataset.data_length["val"],
+        )
 
     def get_test_batches(self, total_batches: int = -1, augment_images: bool = False):
         """Test batches from the fixed test seed (``data.py:623-636``)."""
@@ -127,4 +150,7 @@ class MetaLearningSystemDataLoader:
             self.dataset.data_length["test"] = total_batches * self.batch_size
         self.dataset.switch_set(set_name="test")
         self.dataset.set_augmentation(augment_images=augment_images)
-        yield from self._iter_batches(self.dataset.data_length["test"])
+        yield from self._iter_batches(
+            "test", int(self.dataset.seed["test"]), augment_images,
+            self.dataset.data_length["test"],
+        )

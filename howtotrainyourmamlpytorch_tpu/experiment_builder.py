@@ -198,11 +198,13 @@ class ExperimentBuilder:
             print("profiler trace stopped")
 
     def _epoch_step_time_stats(self) -> dict:
+        # Always drop the anchor at epoch end: the next epoch's first
+        # dispatch must not measure the val-epoch + checkpoint gap.
+        self._last_dispatch_t = None
         if not self._step_times:
             return {}
         times = np.asarray(self._step_times)
         self._step_times = []
-        self._last_dispatch_t = None
         return {
             "train_step_time_p50": float(np.percentile(times, 50)),
             "train_step_time_p95": float(np.percentile(times, 95)),

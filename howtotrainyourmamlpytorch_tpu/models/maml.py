@@ -292,27 +292,11 @@ class MAMLFewShotLearner(CheckpointableLearner):
         iteration's metrics (device scalars, lazy)."""
         epoch = int(epoch)
         self.current_epoch = epoch
-        # Pre-stacked form: exactly 4 array-likes (np or device arrays).
-        # A sequence of episode batches has tuples as elements instead.
-        if len(data_batches) == 4 and all(
-            hasattr(b, "ndim") for b in data_batches
-        ):
-            batches = tuple(data_batches)
-        else:
-            prepared = [self._prepare_batch(b) for b in data_batches]
-            batches = tuple(
-                np.stack([p[i] for p in prepared]) for i in range(4)
-            )
-        importance = self._train_importance(epoch)
+        step_fn, batches, importance = self._train_iters_program(
+            data_batches, epoch
+        )
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
-        final_only = not (
-            self.cfg.use_multi_step_loss_optimization
-            and epoch < self.cfg.multi_step_loss_num_epochs
-        )
-        step_fn = self._get_multi_train_step(
-            self._use_second_order(epoch), final_only
-        )
         new_state, metrics = step_fn(state, batches, importance)
         losses = {
             "loss": metrics["loss"],
@@ -327,6 +311,39 @@ class MAMLFewShotLearner(CheckpointableLearner):
             losses[f"loss_importance_vector_{i}"] = float(v)
         losses["learning_rate"] = lr
         return new_state, losses
+
+    def _train_iters_program(self, data_batches, epoch: int):
+        """The exact ``(step_fn, stacked_batches, importance)`` that
+        ``run_train_iters`` executes for this epoch — single source of truth
+        for the program-variant selection (second order, MSL final-only)."""
+        # Pre-stacked form: exactly 4 array-likes (np or device arrays).
+        # A sequence of episode batches has tuples as elements instead.
+        if len(data_batches) == 4 and all(
+            hasattr(b, "ndim") for b in data_batches
+        ):
+            batches = tuple(data_batches)
+        else:
+            prepared = [self._prepare_batch(b) for b in data_batches]
+            batches = tuple(
+                np.stack([p[i] for p in prepared]) for i in range(4)
+            )
+        importance = self._train_importance(epoch)
+        final_only = not (
+            self.cfg.use_multi_step_loss_optimization
+            and epoch < self.cfg.multi_step_loss_num_epochs
+        )
+        step_fn = self._get_multi_train_step(
+            self._use_second_order(epoch), final_only
+        )
+        return step_fn, batches, importance
+
+    def lowered_train_iters(self, state: TrainState, data_batches, epoch):
+        """Lowers (without running) the same program ``run_train_iters``
+        dispatches — for cost analysis / AOT inspection (bench.py MFU)."""
+        step_fn, batches, importance = self._train_iters_program(
+            data_batches, int(epoch)
+        )
+        return step_fn.lower(state, batches, jnp.asarray(importance))
 
     # ------------------------------------------------------------------
     # Initialization

@@ -168,3 +168,36 @@ def test_ram_preload_matches_disk(dataset_env):
     e_ram = ram.get_set("val", seed=7, augment_images=False)
     np.testing.assert_allclose(e_disk[0], e_ram[0])
     np.testing.assert_array_equal(e_disk[2], e_ram[2])
+
+def test_interleaved_val_epoch_does_not_poison_train_stream(dataset_env):
+    """Regression: the experiment loop holds ONE long-lived train generator
+    and runs val epochs inside it (experiment_builder.py:402-449, mirroring
+    the reference's loop at experiment_builder.py:308-343). The thread-pool
+    synthesis shares a single dataset object, so a val epoch's
+    ``switch_set("val")``/``set_augmentation(False)`` must NOT leak into
+    train batches produced afterwards — every post-val train batch must
+    still be an augmented train-split episode with the train seed stream."""
+    args = make_args(dataset_env)
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+
+    train_gen = loader.get_train_batches(total_batches=8, augment_images=True)
+    got = [next(train_gen)]
+    # Interleave a full val epoch (evaluation never augments).
+    val_batches = list(loader.get_val_batches(total_batches=2,
+                                              augment_images=False))
+    assert len(val_batches) == 2
+    got.extend(train_gen)  # drain the remaining 7 train batches
+    assert len(got) == 8
+
+    # Expected stream, synthesized directly with explicit train arguments.
+    ds = FewShotLearningDataset(args)
+    for b, (xs, xt, ys, yt, seeds) in enumerate(got):
+        for i in range(args.batch_size):
+            idx = b * loader.global_batch + i
+            seed = ds.init_seed["train"] + idx
+            assert seeds[i] == seed
+            exp_xs, _exp_xt, exp_ys, _e, _s = ds.get_set(
+                "train", seed=seed, augment_images=True
+            )
+            np.testing.assert_array_equal(xs[i], exp_xs)
+            np.testing.assert_array_equal(ys[i], exp_ys)
