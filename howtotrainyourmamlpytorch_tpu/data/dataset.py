@@ -40,6 +40,7 @@ import numpy as np
 from PIL import Image, ImageFile
 
 from .augment import augment_image
+from .fast_synth import gather_rot_chw
 
 ImageFile.LOAD_TRUNCATED_IMAGES = True
 
@@ -69,6 +70,7 @@ class FewShotLearningDataset:
         self.num_samples_per_class = args.num_samples_per_class
         self.num_classes_per_set = args.num_classes_per_set
         self.augment_images = False
+        self._class_key_cache: dict = {}
 
         # Derived split seeds (data.py:131-142); test seed == val seed.
         val_seed = np.random.RandomState(seed=args.val_seed).randint(1, 999999)
@@ -257,6 +259,32 @@ class FewShotLearningDataset:
     # Episode synthesis
     # ------------------------------------------------------------------
 
+    def _fast_assembly_ok(self, augment_images: bool) -> bool:
+        """The batched gather/rotate path applies when images are preloaded
+        and the phase's transform chain draws no RNG: everything except
+        cifar's train-time random crop/flip (``data.py:80-89``)."""
+        if not self.data_loaded_in_memory:
+            return False
+        name = self.dataset_name
+        if "cifar10" in name or "cifar100" in name:
+            return not augment_images
+        return True
+
+    def _fast_normalization(self):
+        """``(mean, std)`` broadcastable over ``(N,M,C,H,W)`` for datasets
+        whose (RNG-free) transform chain normalizes, else None."""
+        name = self.dataset_name
+        if "cifar10" in name or "cifar100" in name:
+            mean = np.asarray(self.args.classification_mean, np.float32)
+            std = np.asarray(self.args.classification_std, np.float32)
+        elif "imagenet" in name:
+            from .augment import IMAGENET_MEAN, IMAGENET_STD
+
+            mean, std = IMAGENET_MEAN, IMAGENET_STD
+        else:
+            return None
+        return mean.reshape(-1, 1, 1), std.reshape(-1, 1, 1)
+
     def get_set(self, dataset_name: str, seed: int, augment_images: bool = False):
         """One N-way K-shot episode, deterministically from ``seed``
         (``data.py:478-524``; RNG call order preserved exactly).
@@ -272,8 +300,15 @@ class FewShotLearningDataset:
         # selection from the reference on those datasets (ADVICE r1).
         aug_rng = np.random.RandomState((seed + 0x5EED) % (2**32))
         size_dict = self.dataset_size_dict[dataset_name]
+        # Cached ndarray of the class keys: RandomState.choice converts a
+        # list argument to an array anyway, so draws are identical, and this
+        # skips rebuilding an N-hundred-element list per episode.
+        keys = self._class_key_cache.get(dataset_name)
+        if keys is None:
+            keys = np.asarray(list(size_dict.keys()))
+            self._class_key_cache[dataset_name] = keys
         selected_classes = rng.choice(
-            list(size_dict.keys()), size=self.num_classes_per_set, replace=False
+            keys, size=self.num_classes_per_set, replace=False
         )
         rng.shuffle(selected_classes)
         k_list = rng.randint(0, 4, size=self.num_classes_per_set)
@@ -282,36 +317,65 @@ class FewShotLearningDataset:
             cls: label for label, cls in enumerate(selected_classes)
         }
 
-        x_images, y_labels = [], []
-        for class_entry in selected_classes:
-            choose_samples_list = rng.choice(
+        # RNG call order is fixed above/below regardless of assembly path.
+        sample_lists = [
+            rng.choice(
                 size_dict[class_entry],
                 size=self.num_samples_per_class + self.num_target_samples,
                 replace=False,
             )
-            class_image_samples = []
-            class_labels = []
-            for sample in choose_samples_list:
-                raw = self.datasets[dataset_name][class_entry][sample]
-                x = self.load_image(raw)
-                if self.data_loaded_in_memory:
-                    x = np.asarray(x, np.float32)
-                x = augment_image(
-                    image=x,
-                    k=int(k_dict[class_entry]),
-                    channels=self.image_channel,
-                    augment_bool=augment_images,
-                    args=self.args,
-                    dataset_name=self.dataset_name,
-                    rng=aug_rng,
-                )
-                class_image_samples.append(x)
-                class_labels.append(class_to_episode_label[class_entry])
-            x_images.append(np.stack(class_image_samples))
-            y_labels.append(class_labels)
+            for class_entry in selected_classes
+        ]
 
-        x_images = np.stack(x_images)  # (N, K+T, C, H, W)
-        y_labels = np.array(y_labels, dtype=np.int32)
+        if self._fast_assembly_ok(augment_images):
+            # Gather + rotate + HWC->CHW in one native (or vectorized) pass
+            # per class; bit-identical to the per-image loop below.
+            rotate = augment_images and "omniglot" in self.dataset_name
+            per_class = [
+                gather_rot_chw(
+                    self.datasets[dataset_name][class_entry],
+                    samples,
+                    int(k_dict[class_entry]) if rotate else 0,
+                )
+                for class_entry, samples in zip(selected_classes, sample_lists)
+            ]
+            x_images = np.stack(per_class)  # (N, K+T, C, H, W)
+            norm = self._fast_normalization()
+            if norm is not None:
+                mean, std = norm
+                x_images = (x_images - mean) / std
+            y_labels = np.repeat(
+                np.arange(len(selected_classes), dtype=np.int32)[:, None],
+                x_images.shape[1], axis=1,
+            )
+        else:
+            x_images, y_labels = [], []
+            for class_entry, choose_samples_list in zip(
+                selected_classes, sample_lists
+            ):
+                class_image_samples = []
+                class_labels = []
+                for sample in choose_samples_list:
+                    raw = self.datasets[dataset_name][class_entry][sample]
+                    x = self.load_image(raw)
+                    if self.data_loaded_in_memory:
+                        x = np.asarray(x, np.float32)
+                    x = augment_image(
+                        image=x,
+                        k=int(k_dict[class_entry]),
+                        channels=self.image_channel,
+                        augment_bool=augment_images,
+                        args=self.args,
+                        dataset_name=self.dataset_name,
+                        rng=aug_rng,
+                    )
+                    class_image_samples.append(x)
+                    class_labels.append(class_to_episode_label[class_entry])
+                x_images.append(np.stack(class_image_samples))
+                y_labels.append(class_labels)
+
+            x_images = np.stack(x_images)  # (N, K+T, C, H, W)
+            y_labels = np.array(y_labels, dtype=np.int32)
         k = self.num_samples_per_class
         return (
             x_images[:, :k],

@@ -1,0 +1,67 @@
+"""Fast episode assembly for RAM-preloaded, RNG-free-transform datasets.
+
+``gather_rot_chw(src, idx, k)`` gathers ``src[idx]`` (per-class image store,
+``(S,H,W,C)`` float32), rotates by ``k * 90`` degrees (numpy.rot90 semantics,
+the reference's class-level Omniglot augmentation, ``data.py:17-34,492-493``)
+and returns ``(M,C,H,W)`` float32 — exactly what the per-image
+``augment_image`` + transpose loop in ``get_set`` produces, in one pass.
+
+Uses the native C kernel (``native/episode_synth.c``) through ctypes when a
+compiler is available — the call releases the GIL, so loader threads scale —
+and a vectorized NumPy fallback otherwise. Both are bit-identical to the
+slow path (``tests/test_fast_synth.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..native import load_native_library
+
+_lib = load_native_library("episode_synth")
+if _lib is not None:
+    _lib.gather_rot_chw.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # src
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # H, W, C
+        ctypes.POINTER(ctypes.c_int64),   # idx
+        ctypes.c_int64,                   # M
+        ctypes.c_int,                     # k
+        ctypes.POINTER(ctypes.c_float),   # dst
+    ]
+    _lib.gather_rot_chw.restype = None
+
+
+def native_available() -> bool:
+    return _lib is not None
+
+
+def _gather_rot_chw_numpy(src: np.ndarray, idx: np.ndarray, k: int) -> np.ndarray:
+    out = src[idx]  # (M, H, W, C)
+    if k % 4:
+        out = np.rot90(out, k=k, axes=(1, 2))
+    return np.ascontiguousarray(np.transpose(out, (0, 3, 1, 2)))
+
+
+def gather_rot_chw(src: np.ndarray, idx: np.ndarray, k: int) -> np.ndarray:
+    """``(M,C,H,W)`` float32: ``rot90(src[idx], k)`` transposed to CHW."""
+    k = int(k) % 4
+    S, H, W, C = src.shape
+    if (
+        _lib is None
+        or (k % 2 and H != W)
+        or not src.flags.c_contiguous
+        or src.dtype != np.float32
+    ):
+        return _gather_rot_chw_numpy(src, np.asarray(idx, np.int64), k)
+    idx = np.ascontiguousarray(idx, np.int64)
+    dst = np.empty((len(idx), C, H, W), np.float32)
+    _lib.gather_rot_chw(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        H, W, C,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx), k,
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return dst

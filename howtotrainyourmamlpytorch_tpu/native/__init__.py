@@ -1,0 +1,11 @@
+"""Native (C) runtime components, loaded through ctypes.
+
+Sources are compiled lazily on first use with the system compiler into
+``_build/`` next to this file (gitignored). Every consumer must degrade
+gracefully when no compiler is available — the NumPy fallbacks are
+bit-identical, just slower.
+"""
+
+from .build import load_native_library
+
+__all__ = ["load_native_library"]

@@ -1,0 +1,64 @@
+"""Lazy ctypes build/load for the package's C components.
+
+No pybind11 / Python.h: the kernels use a plain C ABI operating on raw
+buffers, so one ``cc -O3 -shared -fPIC`` per source is the whole build, and
+ctypes releases the GIL for the call's duration (the point: synthesis
+threads actually run in parallel).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL | None] = {}
+
+
+def _compiler() -> str | None:
+    for cc in ("cc", "gcc", "g++", "clang"):
+        if shutil.which(cc):
+            return cc
+    return None
+
+
+def load_native_library(name: str) -> ctypes.CDLL | None:
+    """Compiles ``<name>.c`` (once; result cached on disk and in-process)
+    and returns the loaded library, or None when no compiler is available
+    or compilation fails — callers fall back to NumPy."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_DIR, f"{name}.c")
+        out = os.path.join(_BUILD_DIR, f"{name}.so")
+        lib = None
+        try:
+            if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+                cc = _compiler()
+                if cc is None:
+                    raise RuntimeError("no C compiler on PATH")
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = out + f".tmp{os.getpid()}"
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", src, "-o", tmp],
+                    check=True, capture_output=True,
+                )
+                os.replace(tmp, out)  # atomic vs concurrent builders
+            lib = ctypes.CDLL(out)
+        except Exception as exc:  # noqa: BLE001 — optional acceleration
+            detail = getattr(exc, "stderr", None)  # compiler diagnostics
+            if isinstance(detail, bytes):
+                detail = detail.decode(errors="replace")
+            suffix = f": {detail.strip()}" if detail else ""
+            print(
+                f"native {name} unavailable ({exc}{suffix}); "
+                "using NumPy fallback"
+            )
+            lib = None
+        _cache[name] = lib
+        return lib

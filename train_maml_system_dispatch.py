@@ -1,0 +1,22 @@
+"""Internal helper for sequential golden runs (not part of the public CLI).
+
+Runs a config's entry point twice — the train phase pauses via sys.exit
+after total_epochs_before_pause (reference semantics), the second invocation
+resumes and runs the final top-5-ensemble test eval. Exit code is the worst
+of the two phases."""
+import subprocess
+import sys
+
+cfg = sys.argv[1]
+entry = ("train_gradient_descent_system.py" if "gradient-descent" in cfg
+         else "train_matching_nets_system.py" if "matching-nets" in cfg
+         else "train_maml_system.py")
+codes = []
+for phase in ("train", "test"):
+    print(f"--- {cfg}: {phase} phase via {entry}", flush=True)
+    proc = subprocess.run(
+        [sys.executable, "-u", entry, "--name_of_args_json_file",
+         f"experiment_config/{cfg}.json"], check=False,
+    )
+    codes.append(proc.returncode)
+sys.exit(max(codes))
