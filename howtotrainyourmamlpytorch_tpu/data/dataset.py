@@ -48,6 +48,10 @@ _IMAGE_EXTS = (".jpeg", ".png", ".jpg")
 
 
 class FewShotLearningDataset:
+    # Lazily created per instance in get_set (class-level default so that
+    # fixture-driven construction via __new__ — tests/test_golden_episodes —
+    # works without __init__).
+    _class_key_cache: dict | None = None
     """Episode synthesizer with deterministic per-index task sampling."""
 
     def __init__(self, args):
@@ -70,7 +74,6 @@ class FewShotLearningDataset:
         self.num_samples_per_class = args.num_samples_per_class
         self.num_classes_per_set = args.num_classes_per_set
         self.augment_images = False
-        self._class_key_cache: dict = {}
 
         # Derived split seeds (data.py:131-142); test seed == val seed.
         val_seed = np.random.RandomState(seed=args.val_seed).randint(1, 999999)
@@ -303,10 +306,13 @@ class FewShotLearningDataset:
         # Cached ndarray of the class keys: RandomState.choice converts a
         # list argument to an array anyway, so draws are identical, and this
         # skips rebuilding an N-hundred-element list per episode.
-        keys = self._class_key_cache.get(dataset_name)
+        cache = self._class_key_cache
+        if cache is None:
+            cache = self._class_key_cache = {}
+        keys = cache.get(dataset_name)
         if keys is None:
             keys = np.asarray(list(size_dict.keys()))
-            self._class_key_cache[dataset_name] = keys
+            cache[dataset_name] = keys
         selected_classes = rng.choice(
             keys, size=self.num_classes_per_set, replace=False
         )
