@@ -28,6 +28,13 @@ import numpy as np
 from .dataset import FewShotLearningDataset
 
 
+class _ProducerError:
+    """Queue marker carrying a synthesis-thread exception to the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class MetaLearningSystemDataLoader:
     """Train/val/test episode-batch generators over the episode dataset."""
 
@@ -97,11 +104,32 @@ class MetaLearningSystemDataLoader:
             )
 
         def produce():
-            for b in range(n_batches):
-                idxs = range(b * self.global_batch, (b + 1) * self.global_batch)
-                episodes = list(self._pool.map(synthesize, idxs))
-                out.put(self._collate(episodes))
-            out.put(sentinel)
+            try:
+                for b in range(n_batches):
+                    idxs = range(
+                        b * self.global_batch, (b + 1) * self.global_batch
+                    )
+                    episodes = list(self._pool.map(synthesize, idxs))
+                    out.put(self._collate(episodes))
+            except BaseException as exc:
+                # Pool torn down under us (interpreter exiting with the
+                # consumer gone, or an explicit executor shutdown) -> stop
+                # quietly. Any OTHER failure (e.g. a corrupt image mid-epoch)
+                # is forwarded to the consumer and re-raised there;
+                # swallowing it would silently truncate the epoch.
+                teardown = (
+                    isinstance(exc, RuntimeError)
+                    and (concurrent.futures.thread._shutdown
+                         or self._pool._shutdown)
+                )
+                if not teardown:
+                    out.put(_ProducerError(exc))
+            finally:
+                # MUST block: with the queue full of unconsumed batches a
+                # put_nowait would drop the sentinel and strand the consumer
+                # in out.get() forever. Abandoned consumers leave this daemon
+                # thread parked on a full queue, which is harmless.
+                out.put(sentinel)
 
         thread = threading.Thread(target=produce, daemon=True)
         thread.start()
@@ -109,6 +137,9 @@ class MetaLearningSystemDataLoader:
             batch = out.get()
             if batch is sentinel:
                 break
+            if isinstance(batch, _ProducerError):
+                thread.join()
+                raise batch.exc
             yield batch
         thread.join()
 

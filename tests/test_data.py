@@ -2,6 +2,7 @@
 augmentation, loader batching and resume seed fast-forward (SURVEY §4 test
 strategy — fixed-seed episode-sampler golden behavior)."""
 
+import time
 import json
 import os
 
@@ -201,3 +202,31 @@ def test_interleaved_val_epoch_does_not_poison_train_stream(dataset_env):
             )
             np.testing.assert_array_equal(xs[i], exp_xs)
             np.testing.assert_array_equal(ys[i], exp_ys)
+
+
+def test_loader_sentinel_survives_full_prefetch_queue(dataset_env):
+    """The end-of-epoch sentinel must be delivered even when the consumer
+    lags and the bounded prefetch queue is full when the producer finishes
+    (a put_nowait here once dropped it and stranded the consumer forever)."""
+    args = make_args(dataset_env)
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+    gen = loader.get_train_batches(total_batches=4, augment_images=False)
+    first = next(gen)
+    assert first[0].shape[0] == 4
+    time.sleep(0.5)  # let the producer finish all batches + fill the queue
+    rest = list(gen)  # must terminate, not hang
+    assert len(rest) == 3
+
+
+def test_loader_propagates_synthesis_errors(dataset_env):
+    """A mid-epoch synthesis failure re-raises in the consumer instead of
+    silently truncating the epoch."""
+    args = make_args(dataset_env)
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+
+    def boom(*a, **k):
+        raise ValueError("corrupt image")
+
+    loader.dataset.get_set = boom
+    with pytest.raises(ValueError, match="corrupt image"):
+        list(loader.get_train_batches(total_batches=2, augment_images=False))
