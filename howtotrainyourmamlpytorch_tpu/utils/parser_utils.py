@@ -75,6 +75,9 @@ def get_parser() -> argparse.ArgumentParser:
     # conv_norm (reference backbone) or norm_conv (its unused C7 block,
     # meta_neural_network_architectures.py:436-539) — TPU-flag extension.
     add("--block_order", type=str, default="conv_norm")
+    # Fused Pallas bn+leaky_relu on one-level-AD paths (eval / baselines) —
+    # measured 1.12x eval throughput on TPU v5e (PERF_NOTES.md). TPU flag.
+    add("--use_pallas_fused_norm", type=str, default="False")
     add("--max_pooling", type=str, default="False")
     add("--per_step_bn_statistics", type=str, default="False")
     add("--num_classes_per_set", type=int, default=20)
@@ -167,6 +170,9 @@ def args_to_maml_config(args):
         max_pooling=bool(args.max_pooling),
         norm_layer=args.norm_layer,
         block_order=getattr(args, "block_order", "conv_norm"),
+        use_pallas_fused_norm=bool(
+            getattr(args, "use_pallas_fused_norm", False)
+        ),
         per_step_bn_statistics=bool(args.per_step_bn_statistics),
         num_steps=int(args.number_of_training_steps_per_iter),
         enable_inner_loop_optimizable_bn_params=bool(
