@@ -41,7 +41,11 @@ import numpy as np
 from PIL import Image, ImageFile
 
 from .augment import augment_image
-from .fast_synth import gather_rot_chw
+from .fast_synth import (
+    assemble_episode_native,
+    gather_rot_chw,
+    native_available,
+)
 
 ImageFile.LOAD_TRUNCATED_IMAGES = True
 
@@ -55,6 +59,9 @@ class FewShotLearningDataset:
     _class_key_cache: dict | None = None
     # Thread-local reusable RandomState pair (same __new__-safe pattern).
     _episode_tls: threading.local | None = None
+    # Per-dataset {class_key: base address} of the preloaded stores (lazy,
+    # __new__-safe) for the one-call native episode assembly.
+    _class_addr_cache: dict | None = None
     """Episode synthesizer with deterministic per-index task sampling."""
 
     def __init__(self, args):
@@ -349,18 +356,49 @@ class FewShotLearningDataset:
         ]
 
         if self._fast_assembly_ok(augment_images):
-            # Gather + rotate + HWC->CHW in one native (or vectorized) pass
-            # per class; bit-identical to the per-image loop below.
+            # Gather + rotate + HWC->CHW, bit-identical to the per-image
+            # loop below. Preferred: the whole episode in ONE native call
+            # (N class stores addressed by pointer — ctypes marshalling per
+            # class was ~2/3 of the per-class path's cost).
             rotate = augment_images and "omniglot" in self.dataset_name
-            per_class = [
-                gather_rot_chw(
-                    self.datasets[dataset_name][class_entry],
-                    samples,
-                    int(k_dict[class_entry]) if rotate else 0,
+            store = self.datasets[dataset_name]
+            sample_idx = np.ascontiguousarray(sample_lists, np.int64)
+            ks = (
+                np.ascontiguousarray(k_list, np.int32)
+                if rotate
+                else np.zeros(len(selected_classes), np.int32)
+            )
+            first = store[selected_classes[0]]
+            h, w = first.shape[1], first.shape[2]
+            x_images = None
+            if native_available() and (
+                h == w or not (rotate and np.any(ks % 2))
+            ):
+                addr_cache = self._class_addr_cache
+                if addr_cache is None:
+                    addr_cache = self._class_addr_cache = {}
+                addrs = addr_cache.get(dataset_name)
+                if addrs is None:
+                    # Base addresses of the (immutable, C-contiguous fp32)
+                    # preloaded class stores; the dict also pins liveness
+                    # assumptions to self.datasets, which owns the arrays.
+                    addrs = addr_cache[dataset_name] = {
+                        key: arr.ctypes.data for key, arr in store.items()
+                    }
+                src_addrs = np.fromiter(
+                    (addrs[c] for c in selected_classes),
+                    np.int64, count=len(selected_classes),
                 )
-                for class_entry, samples in zip(selected_classes, sample_lists)
-            ]
-            x_images = np.stack(per_class)  # (N, K+T, C, H, W)
+                x_images = assemble_episode_native(
+                    src_addrs, first.shape[1:], sample_idx, ks
+                )
+            if x_images is None:  # no native lib (or non-square odd rot)
+                x_images = np.stack([
+                    gather_rot_chw(store[class_entry], samples, int(k))
+                    for class_entry, samples, k in zip(
+                        selected_classes, sample_lists, ks
+                    )
+                ])  # (N, K+T, C, H, W)
             norm = self._fast_normalization()
             if norm is not None:
                 mean, std = norm

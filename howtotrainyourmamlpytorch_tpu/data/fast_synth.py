@@ -31,6 +31,18 @@ if _lib is not None:
         ctypes.POINTER(ctypes.c_float),   # dst
     ]
     _lib.gather_rot_chw.restype = None
+    # c_void_p arguments accept plain `arr.ctypes.data` ints — the cheapest
+    # marshalling ctypes offers (data_as/cast per call dominated the old
+    # per-class path).
+    _lib.assemble_episode.argtypes = [
+        ctypes.c_void_p,                  # src_ptrs (int64[N])
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # H, W, C
+        ctypes.c_void_p,                  # idx (int64[N, M])
+        ctypes.c_void_p,                  # ks (int32[N])
+        ctypes.c_int64, ctypes.c_int64,   # N, M
+        ctypes.c_void_p,                  # dst (float32[N, M, C, H, W])
+    ]
+    _lib.assemble_episode.restype = None
 
 
 def native_available() -> bool:
@@ -63,5 +75,28 @@ def gather_rot_chw(src: np.ndarray, idx: np.ndarray, k: int) -> np.ndarray:
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(idx), k,
         dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return dst
+
+
+def assemble_episode_native(
+    src_addrs: np.ndarray,  # (N,) int64 class-store base addresses
+    shape_hwc: tuple,       # (H, W, C) of one image
+    idx: np.ndarray,        # (N, M) int64 sample indices
+    ks: np.ndarray,         # (N,) int32 rotation quarter-turns
+) -> np.ndarray | None:
+    """``(N,M,C,H,W)`` float32 in ONE native call, or None without the lib.
+
+    Callers guarantee: every class store is C-contiguous float32 ``(S,H,W,C)``
+    (the RAM-preload invariant), addresses in ``src_addrs`` stay alive via
+    the caller's references, and H == W when any ``ks`` is odd."""
+    if _lib is None:
+        return None
+    H, W, C = shape_hwc
+    n, m = idx.shape
+    dst = np.empty((n, m, C, H, W), np.float32)
+    _lib.assemble_episode(
+        src_addrs.ctypes.data, H, W, C, idx.ctypes.data, ks.ctypes.data,
+        n, m, dst.ctypes.data,
     )
     return dst

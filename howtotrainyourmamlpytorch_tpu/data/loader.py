@@ -19,8 +19,11 @@ Capability parity with the reference's ``MetaLearningSystemDataLoader``
 
 from __future__ import annotations
 
+import atexit
 import collections
 import concurrent.futures
+import concurrent.futures.process
+import multiprocessing
 import queue
 import threading
 
@@ -34,6 +37,41 @@ class _ProducerError:
 
     def __init__(self, exc: BaseException):
         self.exc = exc
+
+
+def _collate_episodes(episodes):
+    """Stacks per-episode ``(xs, xt, ys, yt, seed)`` tuples into batch
+    arrays."""
+    xs, xt, ys, yt, seeds = zip(*episodes)
+    return (
+        np.stack(xs),
+        np.stack(xt),
+        np.stack(ys),
+        np.stack(yt),
+        np.asarray(seeds),
+    )
+
+
+# Fork-shared dataset for the process synthesis backend: set in the parent
+# immediately before the worker pool forks, inherited copy-on-write by the
+# workers (including the RAM-preloaded image store — no per-task pickling).
+_FORK_DATASET: FewShotLearningDataset | None = None
+
+
+def _synthesize_batch_in_worker(set_name, seed_base, augment, b, global_batch):
+    """One collated batch, synthesized inside a forked worker process.
+    Episode parameters are explicit (snapshot semantics identical to the
+    thread backend); only the collated arrays cross the process boundary."""
+    ds = _FORK_DATASET
+    return _collate_episodes([
+        ds.get_set(set_name, seed=seed_base + idx, augment_images=augment)
+        for idx in range(b * global_batch, (b + 1) * global_batch)
+    ])
+
+
+def _worker_ping():
+    """No-op task used to force worker creation at pool construction."""
+    return None
 
 
 class MetaLearningSystemDataLoader:
@@ -50,9 +88,38 @@ class MetaLearningSystemDataLoader:
         self.batches_per_iter = args.samples_per_iter
         self.full_data_length = dict(self.dataset.data_length)
         self.continue_from_iter(current_iter=current_iter)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=self.num_workers
-        )
+        # Synthesis backend: "thread" (default — PIL/NumPy/native-C release
+        # the GIL, zero IPC) or "process" (the reference's DataLoader-worker
+        # model, data.py:580 — forked workers sidestep the GIL entirely and
+        # inherit the RAM-preloaded dataset copy-on-write; batches cost one
+        # pickle hop back). Process workers scale episode synthesis nearly
+        # linearly and feed the K>1 scan-dispatch mode (--iters_per_dispatch)
+        # at device rate.
+        self.backend = str(
+            getattr(args, "dataprovider_backend", "thread") or "thread"
+        ).lower()
+        if self.backend == "process":
+            global _FORK_DATASET
+            _FORK_DATASET = self.dataset
+            self._pool = concurrent.futures.ProcessPoolExecutor(
+                max_workers=self.num_workers,
+                mp_context=multiprocessing.get_context("fork"),
+            )
+            # ProcessPoolExecutor forks lazily on first submit; force the
+            # fork NOW so the workers snapshot THIS loader's dataset (a
+            # second process-backend loader overwrites the module global).
+            self._pool.submit(_worker_ping).result()
+            # Shut the pool down BEFORE the executor module's own atexit
+            # hook: LIFO ordering means this runs first, so workers exit
+            # while the interpreter is still whole (otherwise its weakref
+            # callback fires mid-teardown and prints an ignored
+            # AttributeError).
+            atexit.register(self._pool.shutdown, wait=True,
+                            cancel_futures=True)
+        else:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.num_workers
+            )
 
     @property
     def global_batch(self) -> int:
@@ -70,14 +137,7 @@ class MetaLearningSystemDataLoader:
 
     def _collate(self, episodes):
         """Stacks per-episode tuples into batch arrays."""
-        xs, xt, ys, yt, seeds = zip(*episodes)
-        return (
-            np.stack(xs),
-            np.stack(xt),
-            np.stack(ys),
-            np.stack(yt),
-            np.asarray(seeds),
-        )
+        return _collate_episodes(episodes)
 
     def _iter_batches(self, set_name: str, seed_base: int, augment: bool,
                       length: int, prefetch: int = 2):
@@ -99,18 +159,28 @@ class MetaLearningSystemDataLoader:
         out: queue.Queue = queue.Queue(maxsize=prefetch)
         sentinel = object()
 
-        def synthesize_batch(b: int):
-            """One collated batch, synthesized serially by a single worker.
-            Batch-granularity tasks (~3ms) amortize executor/queue overhead
-            that per-episode tasks (~0.4ms) drowned in."""
-            return self._collate([
-                self.dataset.get_set(
-                    set_name, seed=seed_base + idx, augment_images=augment
+        if self.backend == "process":
+            def submit(b):
+                return self._pool.submit(
+                    _synthesize_batch_in_worker,
+                    set_name, seed_base, augment, b, self.global_batch,
                 )
-                for idx in range(
-                    b * self.global_batch, (b + 1) * self.global_batch
-                )
-            ])
+        else:
+            def synthesize_batch(b: int):
+                """One collated batch, synthesized serially by one worker
+                thread. Batch-granularity tasks (~3ms) amortize executor/
+                queue overhead that per-episode tasks (~0.4ms) drowned in."""
+                return _collate_episodes([
+                    self.dataset.get_set(
+                        set_name, seed=seed_base + idx, augment_images=augment
+                    )
+                    for idx in range(
+                        b * self.global_batch, (b + 1) * self.global_batch
+                    )
+                ])
+
+            def submit(b):
+                return self._pool.submit(synthesize_batch, b)
 
         def produce():
             try:
@@ -120,7 +190,7 @@ class MetaLearningSystemDataLoader:
                 depth = self.num_workers + prefetch
                 pending: collections.deque = collections.deque()
                 for b in range(n_batches):
-                    pending.append(self._pool.submit(synthesize_batch, b))
+                    pending.append(submit(b))
                     if len(pending) >= depth:
                         out.put(pending.popleft().result())
                 while pending:
@@ -133,8 +203,17 @@ class MetaLearningSystemDataLoader:
                 # swallowing it would silently truncate the epoch.
                 teardown = (
                     isinstance(exc, RuntimeError)
+                    # A crashed worker (BrokenProcessPool) also flips the
+                    # pool's shutdown flag — that is an error to propagate,
+                    # never a quiet stop.
+                    and not isinstance(exc, concurrent.futures.BrokenExecutor)
                     and (concurrent.futures.thread._shutdown
-                         or self._pool._shutdown)
+                         or getattr(concurrent.futures.process,
+                                    "_global_shutdown", False)
+                         # ThreadPoolExecutor._shutdown /
+                         # ProcessPoolExecutor._shutdown_thread
+                         or getattr(self._pool, "_shutdown", False)
+                         or getattr(self._pool, "_shutdown_thread", False))
                 )
                 if not teardown:
                     out.put(_ProducerError(exc))

@@ -19,8 +19,8 @@
 
 #include <stdint.h>
 
-void gather_rot_chw(const float *src, int64_t H, int64_t W, int64_t C,
-                    const int64_t *idx, int64_t M, int k, float *dst) {
+static void gather_one(const float *src, int64_t H, int64_t W, int64_t C,
+                       const int64_t *idx, int64_t M, int k, float *dst) {
     const int64_t img = H * W * C;
     k &= 3;
     for (int64_t m = 0; m < M; ++m) {
@@ -51,4 +51,22 @@ void gather_rot_chw(const float *src, int64_t H, int64_t W, int64_t C,
             }
         }
     }
+}
+
+void gather_rot_chw(const float *src, int64_t H, int64_t W, int64_t C,
+                    const int64_t *idx, int64_t M, int k, float *dst) {
+    gather_one(src, H, W, C, idx, M, k, dst);
+}
+
+/* Whole-episode assembly: N classes in ONE call (ctypes marshalling per
+ * call was ~2/3 of the per-class path's cost). src_ptrs holds the N
+ * class-store base addresses as int64; idx is (N, M) sample indices; ks is
+ * (N,) rotation quarter-turns; dst is (N, M, C, H, W) float32. */
+void assemble_episode(const int64_t *src_ptrs, int64_t H, int64_t W,
+                      int64_t C, const int64_t *idx, const int32_t *ks,
+                      int64_t N, int64_t M, float *dst) {
+    const int64_t cls = M * C * H * W;
+    for (int64_t n = 0; n < N; ++n)
+        gather_one((const float *)(intptr_t)src_ptrs[n], H, W, C,
+                   idx + n * M, M, (int)ks[n], dst + n * cls);
 }

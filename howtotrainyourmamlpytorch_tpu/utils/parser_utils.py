@@ -78,6 +78,10 @@ def get_parser() -> argparse.ArgumentParser:
     # Fused Pallas bn+leaky_relu on one-level-AD paths (eval / baselines) —
     # measured 1.12x eval throughput on TPU v5e (PERF_NOTES.md). TPU flag.
     add("--use_pallas_fused_norm", type=str, default="False")
+    # Episode-synthesis backend: "thread" (GIL-releasing pool, zero IPC) or
+    # "process" (reference DataLoader-worker model: forked workers, linear
+    # scaling past the GIL). TPU flag.
+    add("--dataprovider_backend", type=str, default="thread")
     add("--max_pooling", type=str, default="False")
     add("--per_step_bn_statistics", type=str, default="False")
     add("--num_classes_per_set", type=int, default=20)

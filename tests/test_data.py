@@ -230,3 +230,25 @@ def test_loader_propagates_synthesis_errors(dataset_env):
     loader.dataset.get_set = boom
     with pytest.raises(ValueError, match="corrupt image"):
         list(loader.get_train_batches(total_batches=2, augment_images=False))
+
+
+def test_process_backend_matches_thread_backend(dataset_env):
+    """The forked-worker synthesis backend (reference DataLoader-worker
+    model) yields bit-identical batches to the thread backend."""
+    args = make_args(dataset_env)
+    t = MetaLearningSystemDataLoader(args, current_iter=0)
+    thread_batches = list(t.get_train_batches(total_batches=3,
+                                              augment_images=True))
+    args_p = make_args(dataset_env)
+    args_p.dataprovider_backend = "process"
+    args_p.num_dataprovider_workers = 2
+    p = MetaLearningSystemDataLoader(args_p, current_iter=0)
+    try:
+        proc_batches = list(p.get_train_batches(total_batches=3,
+                                                augment_images=True))
+        assert len(proc_batches) == len(thread_batches) == 3
+        for tb, pb in zip(thread_batches, proc_batches):
+            for a, b in zip(tb, pb):
+                np.testing.assert_array_equal(a, b)
+    finally:
+        p._pool.shutdown(wait=True)
