@@ -115,6 +115,12 @@ def get_parser() -> argparse.ArgumentParser:
     # TPU-specific extensions (absent from the reference).
     add("--compute_dtype", type=str, default="float32",
         help="float32 | bfloat16 (MXU-native)")
+    add("--matmul_precision", type=str, default="default",
+        choices=["default", "high", "highest", "float32"],
+        help="TPU matmuls/convs on f32 inputs use bf16 multiplies under "
+             "'default' (~1%% error, full MXU speed); 'highest'/'float32' "
+             "compute true f32 (~3x matmul cost). Second-order MAML at high "
+             "way-counts can need 'highest' for stability (PERF_NOTES.md).")
     add("--iters_per_dispatch", type=int, default=1,
         help="K meta-updates per device dispatch (lax.scan iteration batching)")
     add("--data_parallel_devices", type=int, default=0,
@@ -156,6 +162,11 @@ def get_args(argv=None):
     args = Bunch(args_dict)
 
     import jax
+
+    # Always set (never skip for "default"): a prior get_args in the same
+    # process may have raised it, and the setting is process-global.
+    precision = str(getattr(args, "matmul_precision", "default") or "default")
+    jax.config.update("jax_default_matmul_precision", precision)
 
     device = jax.devices()[0]
     print("use device", device)

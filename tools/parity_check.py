@@ -1,0 +1,217 @@
+"""Numerical parity harness: this framework vs the reference torch code.
+
+Loads the reference implementation from /root/reference (CPU torch), copies
+its freshly-initialized weights into our TrainState, feeds BOTH the same
+episode batches, and compares per-iteration losses/accuracies and the
+evolving parameters. Answers "is our MAML++ step the same function?"
+independently of init/hyperparameter choices.
+
+Usage: JAX_PLATFORMS=cpu python tools/parity_check.py --ways 20 --iters 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/reference")
+
+from howtotrainyourmamlpytorch_tpu.utils.platform import (  # noqa: E402
+    force_virtual_cpu,
+)
+
+# The axon sitecustomize pre-imports jax targeting the TPU; retarget to CPU
+# BEFORE any backend initializes so the comparison runs both sides on the
+# same host arithmetic (TPU default-precision convs are bf16-multiplied and
+# would dominate the diff).
+force_virtual_cpu(1)
+
+import torch  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from howtotrainyourmamlpytorch_tpu.models import (  # noqa: E402
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import Bunch  # noqa: E402
+
+
+def build_reference(ways, steps, filters, meta_lr, msl_epochs, second_order):
+    from few_shot_learning_system import MAMLFewShotClassifier
+
+    args = Bunch(dict(
+        batch_size=2, image_height=28, image_width=28, image_channels=1,
+        num_stages=4, cnn_num_filters=filters, conv_padding=True,
+        max_pooling=True, norm_layer="batch_norm",
+        per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=steps,
+        number_of_evaluation_steps_per_iter=steps,
+        num_classes_per_set=ways, num_samples_per_class=1,
+        num_target_samples=1,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True, learnable_bn_beta=True,
+        task_learning_rate=0.1, init_inner_loop_learning_rate=0.1,
+        second_order=second_order, first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=msl_epochs,
+        meta_learning_rate=meta_lr, min_learning_rate=1e-5,
+        total_epochs=100, seed=104, use_gdrive=False,
+        device=torch.device("cpu"), use_cuda=False, gpu_to_use=0,
+        dataset_name="omniglot_dataset", weight_decay=0.0,
+    ))
+    return MAMLFewShotClassifier(
+        im_shape=(2, 1, 28, 28), device=torch.device("cpu"), args=args
+    )
+
+
+def build_ours(ways, steps, filters, meta_lr, msl_epochs, second_order):
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=4, num_filters=filters, per_step_bn_statistics=True,
+            num_steps=steps, num_classes=ways, image_channels=1,
+            max_pooling=True,
+        ),
+        number_of_training_steps_per_iter=steps,
+        number_of_evaluation_steps_per_iter=steps,
+        task_learning_rate=0.1,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        second_order=second_order, first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=msl_epochs,
+        meta_learning_rate=meta_lr, min_learning_rate=1e-5,
+        total_epochs=100,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    return learner, learner.init_state(jax.random.PRNGKey(0))
+
+
+def copy_torch_params_into_state(ref, state):
+    """Overwrites our theta/lslr/bn_state with the torch model's values."""
+    # REAL copies: on CPU jax, jnp.asarray of a torch-backed numpy view can
+    # be zero-copy, and torch's in-place Adam update would then silently
+    # rewrite "our" parameters mid-comparison.
+    sd = {k: np.array(v.detach().cpu().numpy(), copy=True)
+          for k, v in ref.classifier.state_dict().items()}
+    theta = jax.tree_util.tree_map(lambda x: x, state.theta)  # shallow copy
+    for i in range(4):
+        stage = theta[f"conv{i}"]
+        stage["conv"]["weight"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.conv.weight"])
+        stage["conv"]["bias"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.conv.bias"])
+        stage["norm"]["gamma"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.norm_layer.weight"])
+        stage["norm"]["beta"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.norm_layer.bias"])
+    theta["linear"]["weight"] = jnp.asarray(sd["layer_dict.linear.weights"])
+    theta["linear"]["bias"] = jnp.asarray(sd["layer_dict.linear.bias"])
+
+    bn = {}
+    from howtotrainyourmamlpytorch_tpu.ops.norm import BatchNormState
+    for i in range(4):
+        bn[f"conv{i}"] = BatchNormState(
+            running_mean=jnp.asarray(
+                sd[f"layer_dict.conv{i}.norm_layer.running_mean"]),
+            running_var=jnp.asarray(
+                sd[f"layer_dict.conv{i}.norm_layer.running_var"]),
+        )
+    # LSLR init is 0.1 on both sides; copy anyway for exactness.
+    lrs = {k.replace("names_learning_rates_dict.", ""):
+           np.array(v.detach().numpy(), copy=True)
+           for k, v in ref.inner_loop_optimizer.named_parameters()}
+    lslr = jax.tree_util.tree_map(lambda x: x, state.lslr)
+    for i in range(4):
+        lslr[f"conv{i}"]["conv"]["weight"] = jnp.asarray(
+            lrs[f"layer_dict-conv{i}-conv-weight"])
+        lslr[f"conv{i}"]["conv"]["bias"] = jnp.asarray(
+            lrs[f"layer_dict-conv{i}-conv-bias"])
+    lslr["linear"]["weight"] = jnp.asarray(lrs["layer_dict-linear-weights"])
+    lslr["linear"]["bias"] = jnp.asarray(lrs["layer_dict-linear-bias"])
+    return state._replace(theta=theta, bn_state=bn, lslr=lslr)
+
+
+def torch_theta(ref):
+    sd = {k: v.detach().cpu().numpy()
+          for k, v in ref.classifier.state_dict().items()}
+    flat = {}
+    for i in range(4):
+        flat[f"conv{i}.w"] = sd[f"layer_dict.conv{i}.conv.weight"]
+        flat[f"conv{i}.gamma"] = sd[f"layer_dict.conv{i}.norm_layer.weight"]
+    flat["linear.w"] = sd["layer_dict.linear.weights"]
+    return flat
+
+
+def our_theta(state):
+    t = state.theta
+    flat = {}
+    for i in range(4):
+        flat[f"conv{i}.w"] = np.asarray(t[f"conv{i}"]["conv"]["weight"])
+        flat[f"conv{i}.gamma"] = np.asarray(t[f"conv{i}"]["norm"]["gamma"])
+    flat["linear.w"] = np.asarray(t["linear"]["weight"])
+    return flat
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ways", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--filters", type=int, default=8)
+    ap.add_argument("--meta_lr", type=float, default=1e-3)
+    ap.add_argument("--msl_epochs", type=int, default=10)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--first_order", action="store_true")
+    args = ap.parse_args()
+
+    second = not args.first_order
+    torch.manual_seed(104)
+    ref = build_reference(args.ways, args.steps, args.filters, args.meta_lr,
+                          args.msl_epochs, second)
+    learner, state = build_ours(args.ways, args.steps, args.filters,
+                                args.meta_lr, args.msl_epochs, second)
+    state = copy_torch_params_into_state(ref, state)
+
+    b, n, k, t = 2, args.ways, 1, 1
+    rng = np.random.RandomState(7)
+    protos = rng.randn(n, 1, 28, 28).astype("f")
+
+    def batch():
+        xs = np.stack([
+            protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
+            for _ in range(b * (k + t))
+        ])
+        xs = xs.reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
+        ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
+        return (
+            xs[:, :, :k], xs[:, :, k:],
+            ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64),
+        )
+
+    print(f"ways={args.ways} steps={args.steps} filters={args.filters} "
+          f"second_order={second} epoch={args.epoch}")
+    print(f"{'it':>3} {'ref_loss':>10} {'our_loss':>10} {'dloss':>9} "
+          f"{'ref_acc':>8} {'our_acc':>8} {'max|dtheta|':>12}")
+    for it in range(args.iters):
+        xs, xt, ys, yt = batch()
+        # reference per-task shapes: x (n, s, c, h, w), y (n, s)
+        tb = (torch.tensor(xs), torch.tensor(xt),
+              torch.tensor(ys), torch.tensor(yt))
+        ref_losses, _ = ref.run_train_iter(data_batch=tb, epoch=args.epoch)
+        state, our_losses = learner.run_train_iter(
+            state, (xs, xt, ys, yt), args.epoch)
+        rt, ot = torch_theta(ref), our_theta(state)
+        dmax = max(np.max(np.abs(rt[key] - ot[key])) for key in rt)
+        rl = float(ref_losses["loss"]); ol = float(our_losses["loss"])
+        print(f"{it:>3} {rl:>10.6f} {ol:>10.6f} {abs(rl-ol):>9.2e} "
+              f"{float(ref_losses['accuracy']):>8.4f} "
+              f"{float(our_losses['accuracy']):>8.4f} {dmax:>12.3e}")
+
+
+if __name__ == "__main__":
+    main()
