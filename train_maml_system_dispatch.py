@@ -8,6 +8,7 @@ import subprocess
 import sys
 
 cfg = sys.argv[1]
+extra = sys.argv[2:]  # forwarded to the entry point (e.g. --matmul_precision)
 entry = ("train_gradient_descent_system.py" if "gradient-descent" in cfg
          else "train_matching_nets_system.py" if "matching-nets" in cfg
          else "train_maml_system.py")
@@ -16,7 +17,7 @@ for phase in ("train", "test"):
     print(f"--- {cfg}: {phase} phase via {entry}", flush=True)
     proc = subprocess.run(
         [sys.executable, "-u", entry, "--name_of_args_json_file",
-         f"experiment_config/{cfg}.json"], check=False,
+         f"experiment_config/{cfg}.json", *extra], check=False,
     )
     codes.append(proc.returncode)
 sys.exit(max(codes))
