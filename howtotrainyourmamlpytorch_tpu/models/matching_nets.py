@@ -17,13 +17,19 @@ Reference quirks — decided, not silently copied (SURVEY §7):
   here is the *correct* formulation — NLL of the attention-mixed class
   probabilities against the TARGET labels, support-magnitude-normalized
   similarities like the original matching-nets code — which works for any
-  N/K/T. Set ``parity_bug=True`` to reproduce the reference's loss target
-  (only meaningful under its shape coincidence).
-* Like the reference, the returned metrics are the LAST task's
-  (``all_losses`` is reset inside the task loop, ``matching_nets.py:94-95``):
-  we instead return the batch mean, which is what its own
-  ``get_across_task_loss_metrics`` intends; per-task preds are returned for
-  the ensemble path either way.
+  N/K/T. Set ``parity_bug=True`` to reproduce the reference bug-for-bug —
+  verified numerically exact against the live reference code by
+  tests/test_reference_parity.py: the element-magnitude "cosine" divisor
+  (``:369-376``), softmax over the target axis, support-indexed attention
+  mixing (``:342-352``), probabilities fed to cross_entropy as logits with
+  SUPPORT labels as targets (``:128``) — only meaningful under its
+  ``N*K == N*T == num_classes`` shape coincidence.
+* Metrics: the reference resets its metric lists inside the task loop
+  (``matching_nets.py:92-97``) and therefore reports only the LAST task's
+  loss/accuracy. The default here returns the batch mean (what its own
+  ``get_across_task_loss_metrics`` intends; statistically equivalent over
+  an epoch); ``parity_bug=True`` reproduces the last-task-only reporting.
+  Per-task preds are returned for the ensemble path either way.
 """
 
 from __future__ import annotations
@@ -117,15 +123,37 @@ class MatchingNetsLearner(CheckpointableLearner):
         num_classes = self.cfg.backbone.num_classes
         support_emb, bn1 = self.backbone.apply(theta, bn, xs, 0)
         target_emb, bn2 = self.backbone.apply(theta, bn1, xt, 0)
-        preds = cosine_attention_predictions(support_emb, target_emb, ys, num_classes)
         if self.parity_bug:
-            # Reference behavior: probabilities treated as logits, support
-            # labels as targets (matching_nets.py:128).
+            # Bug-for-bug reference reproduction (matching_nets.py:338-352,
+            # 98-145), verified numerically exact by
+            # tests/test_reference_parity.py: sims[s, t] softmaxed over the
+            # TARGET axis (legacy nn.Softmax() dim for 2-D), mixed with
+            # support-indexed one-hots (an axis confusion that only
+            # conforms when S == T), the resulting probabilities fed to
+            # cross_entropy as LOGITS with SUPPORT labels as targets
+            # (:128), accuracy still scored against target labels.
+            # The reference's DistanceNetwork "cosine" (:369-376) sums the
+            # squared support vector over a SIZE-1 dim, so the divisor is
+            # |support_s[t]| — the t-th ELEMENT's magnitude, not the norm
+            # (conforms only because feature dim == num targets here).
+            eps = 1e-10
+            inv_mag = jax.lax.rsqrt(jnp.clip(support_emb**2, eps, None))
+            sims_st = (
+                jnp.einsum("sf,tf->st", support_emb, target_emb) * inv_mag
+            )
+            sm = jax.nn.softmax(sims_st, axis=1)
+            onehot = jax.nn.one_hot(ys, num_classes, dtype=sm.dtype)
+            preds = sm @ onehot
             log_probs = jax.nn.log_softmax(preds, axis=-1)
             loss = -jnp.mean(
-                jnp.take_along_axis(log_probs, ys[..., None].astype(jnp.int32), axis=-1)
+                jnp.take_along_axis(
+                    log_probs, ys[..., None].astype(jnp.int32), axis=-1
+                )
             )
         else:
+            preds = cosine_attention_predictions(
+                support_emb, target_emb, ys, num_classes
+            )
             loss = -jnp.mean(
                 jnp.log(
                     jnp.take_along_axis(
@@ -159,7 +187,14 @@ class MatchingNetsLearner(CheckpointableLearner):
             (xs_b, ys_b, xt_b, yt_b),
         )
         new_state = MatchingNetsState(theta, bn, opt_state, state.iteration + 1)
-        metrics = dict(loss=jnp.mean(losses), accuracy=jnp.mean(accs))
+        if self.parity_bug:
+            # The reference re-initializes its metric lists INSIDE the task
+            # loop (matching_nets.py:92-97), so it reports only the LAST
+            # task's loss/accuracy. Statistically equivalent over an epoch
+            # (tasks are iid) but reproduced here for bug-exact parity.
+            metrics = dict(loss=losses[-1], accuracy=accs[-1])
+        else:
+            metrics = dict(loss=jnp.mean(losses), accuracy=jnp.mean(accs))
         return new_state, metrics, preds
 
     # -- trainer contract ------------------------------------------------

@@ -82,3 +82,94 @@ def test_first_order_train_iters_match_reference():
         assert abs(rl - ol) < 1e-4, (it, rl, ol)
         assert abs(ra - oa) < 1e-6, (it, ra, oa)
         assert dtheta < 1e-4, (it, dtheta)
+
+
+def test_validation_iter_matches_reference():
+    """Eval episodes (reference run_validation_iter,
+    few_shot_learning_system.py:371-397): same weights + batch -> same loss,
+    accuracy, and per-task target logits; our state must be unchanged (the
+    functional form of the reference's BN backup/restore)."""
+    torch.manual_seed(104)
+    ref = build_reference(5, 3, 8, 1e-3, 10, True)
+    learner, state = build_ours(5, 3, 8, 1e-3, 10, True)
+    state = copy_torch_params_into_state(ref, state)
+
+    b, n, k, t = 2, 5, 1, 1
+    rng = np.random.RandomState(11)
+    protos = rng.randn(n, 1, 28, 28).astype("f")
+    xs = np.stack([
+        protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
+        for _ in range(b * (k + t))
+    ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
+    ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
+    batch = (xs[:, :, :k], xs[:, :, k:],
+             ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
+
+    tb = tuple(torch.tensor(a) for a in batch)
+    # Materialize host copies BEFORE the call: run_validation_iter returns
+    # its input state object, so comparing state to new_state afterwards
+    # would be vacuous.
+    theta_before = {k: v.copy() for k, v in our_theta(state).items()}
+    ref_losses, ref_preds = ref.run_validation_iter(data_batch=tb)
+    new_state, our_losses, our_preds = learner.run_validation_iter(state, batch)
+
+    assert abs(float(ref_losses["loss"]) - float(our_losses["loss"])) < 1e-4
+    assert abs(float(ref_losses["accuracy"])
+               - float(our_losses["accuracy"])) < 1e-6
+    np.testing.assert_allclose(
+        np.asarray(our_preds), np.stack(ref_preds), atol=1e-4
+    )
+    # purity: eval must not move our train state
+    for key, before in theta_before.items():
+        np.testing.assert_array_equal(before, our_theta(new_state)[key])
+
+
+def test_matching_nets_train_iter_matches_reference():
+    """Our MatchingNetsLearner with parity_bug=True is the reference's
+    matching-nets step (matching_nets.py:98-145, including its support-set
+    loss-target quirk at :128 and the per-task Adam update) — proving the
+    golden-run accuracy gap (0.952 vs the reference's bundled 0.612) comes
+    from that reference bug, not from solving a different problem."""
+    import jax
+    from parity_check import build_reference_matching_nets, copy_torch_backbone
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig, MAMLConfig, MatchingNetsLearner,
+    )
+
+    torch.manual_seed(104)
+    ref = build_reference_matching_nets(5, 8)
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=4, num_filters=8, per_step_bn_statistics=False,
+            num_steps=1, num_classes=5, image_channels=1, max_pooling=True,
+        ),
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        second_order=False, meta_learning_rate=1e-3, min_learning_rate=1e-5,
+        total_epochs=100,
+    )
+    learner = MatchingNetsLearner(cfg, parity_bug=True)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    sd = {k: np.array(v.detach().cpu().numpy(), copy=True)
+          for k, v in ref.classifier.state_dict().items()}
+    theta, bn = copy_torch_backbone(sd, state.theta)
+    state = state._replace(theta=theta, bn_state=bn)
+
+    b, n, k, t = 2, 5, 1, 1
+    rng = np.random.RandomState(3)
+    protos = rng.randn(n, 1, 28, 28).astype("f")
+    for it in range(3):
+        xs = np.stack([
+            protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
+            for _ in range(b * (k + t))
+        ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
+        ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
+        batch = (xs[:, :, :k], xs[:, :, k:],
+                 ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
+        tb = tuple(torch.tensor(a) for a in batch)
+        ref_losses, _ = ref.run_train_iter(data_batch=tb, epoch=0)
+        state, our_losses = learner.run_train_iter(state, batch, 0)
+        assert abs(float(ref_losses["loss"].detach())
+                   - float(our_losses["loss"])) < 1e-4, it
+        assert abs(float(ref_losses["accuracy"])
+                   - float(our_losses["accuracy"])) < 1e-6, it

@@ -41,10 +41,9 @@ from howtotrainyourmamlpytorch_tpu.models import (  # noqa: E402
 from howtotrainyourmamlpytorch_tpu.utils.parser_utils import Bunch  # noqa: E402
 
 
-def build_reference(ways, steps, filters, meta_lr, msl_epochs, second_order):
-    from few_shot_learning_system import MAMLFewShotClassifier
-
-    args = Bunch(dict(
+def _reference_args(ways, steps, filters, meta_lr, msl_epochs, second_order,
+                    **overrides):
+    d = dict(
         batch_size=2, image_height=28, image_width=28, image_channels=1,
         num_stages=4, cnn_num_filters=filters, conv_padding=True,
         max_pooling=True, norm_layer="batch_norm",
@@ -64,7 +63,60 @@ def build_reference(ways, steps, filters, meta_lr, msl_epochs, second_order):
         total_epochs=100, seed=104, use_gdrive=False,
         device=torch.device("cpu"), use_cuda=False, gpu_to_use=0,
         dataset_name="omniglot_dataset", weight_decay=0.0,
-    ))
+    )
+    d.update(overrides)
+    return Bunch(d)
+
+
+def copy_torch_backbone(sd, theta):
+    """Torch VGGReLUNormNetwork state_dict (already materialized as real
+    numpy copies) -> (theta, bn_state) pytrees. The produced arrays take
+    the state_dict's shapes, which cover per-step (S, F) and shared (F,)
+    BN layouts alike."""
+    from howtotrainyourmamlpytorch_tpu.ops.norm import BatchNormState
+
+    theta = jax.tree_util.tree_map(lambda x: x, theta)
+    bn = {}
+    for i in range(4):
+        stage = theta[f"conv{i}"]
+        stage["conv"]["weight"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.conv.weight"])
+        stage["conv"]["bias"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.conv.bias"])
+        stage["norm"]["gamma"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.norm_layer.weight"])
+        stage["norm"]["beta"] = jnp.asarray(
+            sd[f"layer_dict.conv{i}.norm_layer.bias"])
+        bn[f"conv{i}"] = BatchNormState(
+            running_mean=jnp.asarray(
+                sd[f"layer_dict.conv{i}.norm_layer.running_mean"]),
+            running_var=jnp.asarray(
+                sd[f"layer_dict.conv{i}.norm_layer.running_var"]),
+        )
+    theta["linear"]["weight"] = jnp.asarray(sd["layer_dict.linear.weights"])
+    theta["linear"]["bias"] = jnp.asarray(sd["layer_dict.linear.bias"])
+    return theta, bn
+
+
+def build_reference_matching_nets(ways, filters):
+    from matching_nets import MatchingNetsFewShotClassifier
+
+    args = _reference_args(
+        ways, 1, filters, 1e-3, 10, False,
+        per_step_bn_statistics=False,
+        learnable_per_layer_per_step_inner_loop_learning_rate=False,
+        use_multi_step_loss_optimization=False,
+    )
+    return MatchingNetsFewShotClassifier(
+        im_shape=(2, 1, 28, 28), device=torch.device("cpu"), args=args
+    )
+
+
+def build_reference(ways, steps, filters, meta_lr, msl_epochs, second_order):
+    from few_shot_learning_system import MAMLFewShotClassifier
+
+    args = _reference_args(ways, steps, filters, meta_lr, msl_epochs,
+                           second_order)
     return MAMLFewShotClassifier(
         im_shape=(2, 1, 28, 28), device=torch.device("cpu"), args=args
     )
@@ -98,29 +150,7 @@ def copy_torch_params_into_state(ref, state):
     # rewrite "our" parameters mid-comparison.
     sd = {k: np.array(v.detach().cpu().numpy(), copy=True)
           for k, v in ref.classifier.state_dict().items()}
-    theta = jax.tree_util.tree_map(lambda x: x, state.theta)  # shallow copy
-    for i in range(4):
-        stage = theta[f"conv{i}"]
-        stage["conv"]["weight"] = jnp.asarray(
-            sd[f"layer_dict.conv{i}.conv.weight"])
-        stage["conv"]["bias"] = jnp.asarray(
-            sd[f"layer_dict.conv{i}.conv.bias"])
-        stage["norm"]["gamma"] = jnp.asarray(
-            sd[f"layer_dict.conv{i}.norm_layer.weight"])
-        stage["norm"]["beta"] = jnp.asarray(
-            sd[f"layer_dict.conv{i}.norm_layer.bias"])
-    theta["linear"]["weight"] = jnp.asarray(sd["layer_dict.linear.weights"])
-    theta["linear"]["bias"] = jnp.asarray(sd["layer_dict.linear.bias"])
-
-    bn = {}
-    from howtotrainyourmamlpytorch_tpu.ops.norm import BatchNormState
-    for i in range(4):
-        bn[f"conv{i}"] = BatchNormState(
-            running_mean=jnp.asarray(
-                sd[f"layer_dict.conv{i}.norm_layer.running_mean"]),
-            running_var=jnp.asarray(
-                sd[f"layer_dict.conv{i}.norm_layer.running_var"]),
-        )
+    theta, bn = copy_torch_backbone(sd, state.theta)
     # LSLR init is 0.1 on both sides; copy anyway for exactness.
     lrs = {k.replace("names_learning_rates_dict.", ""):
            np.array(v.detach().numpy(), copy=True)
@@ -215,3 +245,4 @@ def main():
 
 if __name__ == "__main__":
     main()
+
