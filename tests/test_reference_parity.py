@@ -33,6 +33,7 @@ from parity_check import (  # noqa: E402
     build_ours,
     build_reference,
     copy_torch_params_into_state,
+    make_episode_batch,
     our_theta,
     torch_theta,
 )
@@ -49,13 +50,7 @@ def _run_pair(ways: int, iters: int, second_order: bool):
     protos = rng.randn(n, 1, 28, 28).astype("f")
     results = []
     for _ in range(iters):
-        xs = np.stack([
-            protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
-            for _ in range(b * (k + t))
-        ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
-        ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
-        batch = (xs[:, :, :k], xs[:, :, k:],
-                 ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
+        batch = make_episode_batch(rng, protos, b, n, k, t)
         tb = tuple(torch.tensor(a) for a in batch)
         ref_losses, _ = ref.run_train_iter(data_batch=tb, epoch=0)
         state, our_losses = learner.run_train_iter(state, batch, 0)
@@ -97,13 +92,7 @@ def test_validation_iter_matches_reference():
     b, n, k, t = 2, 5, 1, 1
     rng = np.random.RandomState(11)
     protos = rng.randn(n, 1, 28, 28).astype("f")
-    xs = np.stack([
-        protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
-        for _ in range(b * (k + t))
-    ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
-    ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
-    batch = (xs[:, :, :k], xs[:, :, k:],
-             ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
+    batch = make_episode_batch(rng, protos, b, n, k, t)
 
     tb = tuple(torch.tensor(a) for a in batch)
     # Materialize host copies BEFORE the call: run_validation_iter returns
@@ -159,13 +148,7 @@ def test_matching_nets_train_iter_matches_reference():
     rng = np.random.RandomState(3)
     protos = rng.randn(n, 1, 28, 28).astype("f")
     for it in range(3):
-        xs = np.stack([
-            protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
-            for _ in range(b * (k + t))
-        ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
-        ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
-        batch = (xs[:, :, :k], xs[:, :, k:],
-                 ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
+        batch = make_episode_batch(rng, protos, b, n, k, t)
         tb = tuple(torch.tensor(a) for a in batch)
         ref_losses, _ = ref.run_train_iter(data_batch=tb, epoch=0)
         state, our_losses = learner.run_train_iter(state, batch, 0)
@@ -173,3 +156,53 @@ def test_matching_nets_train_iter_matches_reference():
                    - float(our_losses["loss"])) < 1e-4, it
         assert abs(float(ref_losses["accuracy"])
                    - float(our_losses["accuracy"])) < 1e-6, it
+
+
+def test_gradient_descent_train_iter_matches_reference():
+    """Our GradientDescentLearner is the reference's baseline step
+    (gradient_descent.py:85-129: per-step Adam updates on the support loss,
+    an extra update on the final target loss, last-task metrics)."""
+    import jax
+    from parity_check import (
+        build_reference_gradient_descent,
+        copy_torch_backbone,
+    )
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig, MAMLConfig, GradientDescentLearner,
+    )
+
+    torch.manual_seed(104)
+    ref = build_reference_gradient_descent(5, 2, 8)
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=4, num_filters=8, per_step_bn_statistics=False,
+            num_steps=2, num_classes=5, image_channels=1, max_pooling=True,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False, meta_learning_rate=1e-3, min_learning_rate=1e-5,
+        total_epochs=100,
+    )
+    learner = GradientDescentLearner(cfg)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    sd = {k: np.array(v.detach().cpu().numpy(), copy=True)
+          for k, v in ref.classifier.state_dict().items()}
+    theta, bn = copy_torch_backbone(sd, state.theta)
+    state = state._replace(theta=theta, bn_state=bn)
+
+    b, n, k, t = 2, 5, 1, 1
+    rng = np.random.RandomState(5)
+    protos = rng.randn(n, 1, 28, 28).astype("f")
+    for it in range(3):
+        batch = make_episode_batch(rng, protos, b, n, k, t)
+        tb = tuple(torch.tensor(a) for a in batch)
+        ref_losses, _ = ref.run_train_iter(data_batch=tb, epoch=0)
+        state, our_losses = learner.run_train_iter(state, batch, 0)
+        assert abs(float(ref_losses["loss"].detach())
+                   - float(our_losses["loss"])) < 1e-4, it
+        assert abs(float(ref_losses["accuracy"])
+                   - float(our_losses["accuracy"])) < 1e-6, it
+        sd2 = ref.classifier.state_dict()
+        w_ref = sd2["layer_dict.conv0.conv.weight"].detach().numpy()
+        w_our = np.asarray(state.theta["conv0"]["conv"]["weight"])
+        assert np.max(np.abs(w_ref - w_our)) < 1e-4, it

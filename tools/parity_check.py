@@ -98,18 +98,40 @@ def copy_torch_backbone(sd, theta):
     return theta, bn
 
 
-def build_reference_matching_nets(ways, filters):
-    from matching_nets import MatchingNetsFewShotClassifier
+def make_episode_batch(rng, protos, b, n, k, t):
+    """(xs, xt, ys, yt) episode batch in the (B, N, S, C, H, W) layout both
+    implementations consume; the single source of the test batch shape."""
+    xs = np.stack([
+        protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
+        for _ in range(b * (k + t))
+    ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
+    ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
+    return (xs[:, :, :k], xs[:, :, k:],
+            ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
 
+
+def _build_reference_baseline(cls, ways, steps, filters):
     args = _reference_args(
-        ways, 1, filters, 1e-3, 10, False,
+        ways, steps, filters, 1e-3, 10, False,
         per_step_bn_statistics=False,
         learnable_per_layer_per_step_inner_loop_learning_rate=False,
         use_multi_step_loss_optimization=False,
     )
-    return MatchingNetsFewShotClassifier(
-        im_shape=(2, 1, 28, 28), device=torch.device("cpu"), args=args
-    )
+    return cls(im_shape=(2, 1, 28, 28), device=torch.device("cpu"), args=args)
+
+
+def build_reference_matching_nets(ways, filters):
+    from matching_nets import MatchingNetsFewShotClassifier
+
+    return _build_reference_baseline(MatchingNetsFewShotClassifier, ways, 1,
+                                     filters)
+
+
+def build_reference_gradient_descent(ways, steps, filters):
+    from gradient_descent import GradientDescentFewShotClassifier
+
+    return _build_reference_baseline(GradientDescentFewShotClassifier, ways,
+                                     steps, filters)
 
 
 def build_reference(ways, steps, filters, meta_lr, msl_epochs, second_order):
@@ -212,16 +234,7 @@ def main():
     protos = rng.randn(n, 1, 28, 28).astype("f")
 
     def batch():
-        xs = np.stack([
-            protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
-            for _ in range(b * (k + t))
-        ])
-        xs = xs.reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
-        ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
-        return (
-            xs[:, :, :k], xs[:, :, k:],
-            ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64),
-        )
+        return make_episode_batch(rng, protos, b, n, k, t)
 
     print(f"ways={args.ways} steps={args.steps} filters={args.filters} "
           f"second_order={second} epoch={args.epoch}")
@@ -245,4 +258,5 @@ def main():
 
 if __name__ == "__main__":
     main()
+
 
