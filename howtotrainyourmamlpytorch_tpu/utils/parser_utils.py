@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 
 
 class Bunch:
@@ -167,6 +168,25 @@ def get_args(argv=None):
     # process may have raised it, and the setting is process-global.
     precision = str(getattr(args, "matmul_precision", "default") or "default")
     jax.config.update("jax_default_matmul_precision", precision)
+    # Runtime guard covering EVERY launch path (the generated scripts pin
+    # this flag, but direct CLI / dispatch invocations may not): 20-way
+    # second-order MAML diverges under the TPU default bf16-multiply
+    # precision (PERF_NOTES.md).
+    second_order = (
+        bool(getattr(args, "second_order", False))
+        or int(getattr(args, "first_order_to_second_order_epoch", -1) or -1) >= 0
+    )
+    if (
+        precision == "default"
+        and second_order
+        and int(getattr(args, "num_classes_per_set", 0) or 0) >= 20
+    ):
+        print(
+            "WARNING: >=20-way second-order MAML diverges at the TPU default "
+            "matmul precision (bf16 multiplies); pass --matmul_precision "
+            "highest (see PERF_NOTES.md).",
+            file=sys.stderr,
+        )
 
     device = jax.devices()[0]
     print("use device", device)

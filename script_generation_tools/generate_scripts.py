@@ -37,13 +37,22 @@ def main() -> None:
         if not file.endswith(".json"):
             continue
         with open(os.path.join(EXPERIMENT_JSON_DIR, file)) as f:
-            model = json.load(f).get("model", "maml")
+            cfg = json.load(f)
+        model = cfg.get("model", "maml")
         lines = list(template)
         lines[-1] = (
             lines[-1]
             .replace("$execution_script$", MODEL_TO_SCRIPT.get(model, DEFAULT_SCRIPT))
             .replace("$experiment_config$", file)
         )
+        # Second-order MAML at 20-way diverges under the TPU's default
+        # bf16-multiply matmul precision (PERF_NOTES.md); pin true f32.
+        second_order = (
+            str(cfg.get("second_order", "")).lower() in ("true", "1")
+            or int(cfg.get("first_order_to_second_order_epoch", -1)) >= 0
+        )
+        if int(cfg.get("num_classes_per_set", 0)) >= 20 and second_order:
+            lines[-1] = lines[-1].rstrip("\n") + " --matmul_precision highest\n"
         out = os.path.join(
             LOCAL_SCRIPT_DIR, "{}_{}.sh".format(file.replace(".json", ""), PREFIX)
         )
