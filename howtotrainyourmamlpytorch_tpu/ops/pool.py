@@ -24,8 +24,19 @@ def max_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
 
 
 def avg_pool2d(x: jax.Array, window: int, stride: int | None = None) -> jax.Array:
-    """Average pooling over ``(N, C, H, W)``, VALID padding."""
+    """Average pooling over ``(N, C, H, W)``, VALID padding.
+
+    Non-overlapping windows (the backbone's global avg pool and torch's
+    default ``stride == window``) lower to a reshape + mean — unlike
+    ``lax.reduce_window``-add, that composes with reverse-over-reverse AD
+    (the MAML outer gradient over the inner ``value_and_grad``; the
+    reduce_window path fails to linearize there)."""
     stride = window if stride is None else stride
+    n, c, h, w = x.shape
+    if stride == window and h % window == 0 and w % window == 0:
+        return x.reshape(
+            n, c, h // window, window, w // window, window
+        ).mean(axis=(3, 5))
     summed = lax.reduce_window(
         x,
         jnp.array(0, x.dtype),

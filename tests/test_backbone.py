@@ -168,3 +168,34 @@ def test_invalid_block_order_raises():
 
     with pytest.raises(ValueError, match="block_order"):
         make(BackboneConfig(block_order="bogus")).init(jax.random.key(0))
+
+
+def test_strided_avgpool_second_order_train_iter():
+    """The avg-pool (max_pooling=False) backbone must survive the MAML
+    outer gradient at BOTH derivative orders — reduce_window-add failed to
+    linearize under reverse-over-reverse AD (ops/pool.py avg_pool2d)."""
+    import jax
+    import numpy as np
+
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig, MAMLConfig, MAMLFewShotLearner,
+    )
+
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=4, num_filters=4, per_step_bn_statistics=True,
+            num_steps=2, num_classes=5, image_channels=3,
+            image_height=20, image_width=20, max_pooling=False,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=True, use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=10,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(2, 5, 1, 3, 20, 20).astype("f")
+    ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1))
+    state, losses = learner.run_train_iter(state, (xs, xs.copy(), ys, ys.copy()), 0)
+    assert np.isfinite(float(losses["loss"]))

@@ -206,3 +206,58 @@ def test_gradient_descent_train_iter_matches_reference():
         w_ref = sd2["layer_dict.conv0.conv.weight"].detach().numpy()
         w_our = np.asarray(state.theta["conv0"]["conv"]["weight"])
         assert np.max(np.abs(w_ref - w_our)) < 1e-4, it
+
+
+def test_strided_imagenet_architecture_matches_reference():
+    """The mini-imagenet backbone variant (84x84x3, 48->8 filters here,
+    max_pooling=False: stride-2 convs + global avg pool,
+    meta_neural_network_architectures.py:565-570,601-606) through full
+    first-order train iterations."""
+    import jax
+    from parity_check import (
+        _reference_args, copy_torch_params_into_state,
+    )
+    from few_shot_learning_system import MAMLFewShotClassifier
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig, MAMLConfig, MAMLFewShotLearner,
+    )
+
+    torch.manual_seed(104)
+    args = _reference_args(
+        5, 2, 8, 1e-3, 10, False,
+        image_height=20, image_width=20, image_channels=3,
+        max_pooling=False,
+    )
+    ref = MAMLFewShotClassifier(
+        im_shape=(2, 3, 20, 20), device=torch.device("cpu"), args=args
+    )
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=4, num_filters=8, per_step_bn_statistics=True,
+            num_steps=2, num_classes=5, image_channels=3,
+            image_height=20, image_width=20, max_pooling=False,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        task_learning_rate=0.1,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        second_order=False, use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=10,
+        meta_learning_rate=1e-3, min_learning_rate=1e-5, total_epochs=100,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    state = copy_torch_params_into_state(ref, state)
+
+    b, n, k, t = 2, 5, 1, 1
+    rng = np.random.RandomState(13)
+    protos = rng.randn(n, 3, 20, 20).astype("f")
+    for it in range(2):
+        batch = make_episode_batch(rng, protos, b, n, k, t)
+        tb = tuple(torch.tensor(a) for a in batch)
+        ref_losses, _ = ref.run_train_iter(data_batch=tb, epoch=0)
+        state, our_losses = learner.run_train_iter(state, batch, 0)
+        assert abs(float(ref_losses["loss"].detach())
+                   - float(our_losses["loss"])) < 1e-4, it
+        assert abs(float(ref_losses["accuracy"])
+                   - float(our_losses["accuracy"])) < 1e-6, it

@@ -100,11 +100,13 @@ def copy_torch_backbone(sd, theta):
 
 def make_episode_batch(rng, protos, b, n, k, t):
     """(xs, xt, ys, yt) episode batch in the (B, N, S, C, H, W) layout both
-    implementations consume; the single source of the test batch shape."""
+    implementations consume; the single source of the test batch shape.
+    Image shape is taken from ``protos`` ((N, C, H, W))."""
+    c, h, w = protos.shape[1:]
     xs = np.stack([
-        protos + 0.3 * rng.randn(n, 1, 28, 28).astype("f")
+        protos + 0.3 * rng.randn(n, c, h, w).astype("f")
         for _ in range(b * (k + t))
-    ]).reshape(b, k + t, n, 1, 28, 28).transpose(0, 2, 1, 3, 4, 5)
+    ]).reshape(b, k + t, n, c, h, w).transpose(0, 2, 1, 3, 4, 5)
     ys = np.tile(np.arange(n)[None, :, None], (b, 1, k + t))
     return (xs[:, :, :k], xs[:, :, k:],
             ys[:, :, :k].astype(np.int64), ys[:, :, k:].astype(np.int64))
