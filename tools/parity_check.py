@@ -16,7 +16,10 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, "/root/reference")
+# APPEND, not prepend: the reference also contains a top-level
+# script_generation_tools package; prepending would shadow this repo's
+# (it broke tests/test_config_surface.py when collected together).
+sys.path.append("/root/reference")
 
 from howtotrainyourmamlpytorch_tpu.utils.platform import (  # noqa: E402
     force_virtual_cpu,
