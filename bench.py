@@ -7,8 +7,10 @@ averages 908.6 s / 500 iters = 0.55 meta-iters/s (BASELINE.md). Synthetic
 episode data isolates device compute, which dominates that number.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
-observability extras — "mfu" (model-FLOPs utilization of the compiled
-train program against the chip's bf16 peak),
+observability extras — "peak_meta_iters_per_s" / "sustained_meta_iters_per_s"
+(best and all-window-mean of the same measurement; "value" itself is the
+median timing window, see _windowed_rates), "mfu" (model-FLOPs utilization
+of the compiled train program against the chip's bf16 peak),
 "bf16_meta_iters_per_s" (the compute_dtype="bfloat16" variant), and
 "real_data_meta_iters_per_s" / "real_data_vs_baseline" (end-to-end rate
 with the real data pipeline attached; null when no datasets/ present),
@@ -19,6 +21,7 @@ the K=25 scan-dispatch mode, --iters_per_dispatch).
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 
@@ -34,6 +37,10 @@ BASELINE_META_ITERS_PER_S = 0.55
 # key real_data_k{K}_meta_iters_per_s is derived from it).
 DISPATCH_CHUNK = 25
 
+# Timing windows for the time-boxed real-data measurements (the median
+# window is reported; see _windowed_rates).
+REAL_DATA_WINDOWS = 3
+
 # Peak dense-matmul throughput per chip, bf16 (MFU denominator). v5e = 197
 # TFLOP/s; fall back to it for unknown kinds (reported MFU is then an
 # estimate against a v5e-class chip).
@@ -46,7 +53,41 @@ PEAK_FLOPS_BY_KIND = {
 }
 
 
-def _measure(cfg, repeats=40, K=DISPATCH_CHUNK):
+def _windowed_rates(windows, run_window):
+    """Run ``run_window() -> (units_done, seconds)`` ``windows`` times and
+    return (median_rate, peak_rate, mean_rate). The bench chip is reached
+    through a shared tunnel whose throughput transiently dips under outside
+    contention (measured 1.1k-3.4k iters/s swings for a bit-identical
+    program, one-sided: contention only ever slows). The median window is
+    the headline statistic: robust to a minority of contended windows,
+    without the upward bias a max-of-noisy-samples would add. The peak and
+    all-window mean are reported alongside for transparency."""
+    rates, total_units, total_dt = [], 0.0, 0.0
+    for _ in range(windows):
+        units, dt = run_window()
+        rates.append(units / dt)
+        total_units += units
+        total_dt += dt
+    return statistics.median(rates), max(rates), total_units / total_dt
+
+
+def _time_boxed_window(budget_s, step, drain):
+    """Build a ``run_window`` for _windowed_rates that keeps calling
+    ``step() -> units`` (async dispatch) for ``budget_s`` seconds, then
+    ``drain()``s the device queue before the window's clock stops."""
+
+    def run_window():
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < budget_s:
+            n += step()
+        drain()
+        return n, time.perf_counter() - t0
+
+    return run_window
+
+
+def _measure(cfg, repeats=40, K=DISPATCH_CHUNK, windows=5):
     from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
 
     learner = MAMLFewShotLearner(cfg)
@@ -59,12 +100,19 @@ def _measure(cfg, repeats=40, K=DISPATCH_CHUNK):
     state, _ = learner.run_train_iters(state, batches, epoch=epoch)  # compile
     jax.block_until_ready(state.theta)
 
-    t0 = time.perf_counter()
-    for _ in range(repeats):
-        state, _ = learner.run_train_iters(state, batches, epoch=epoch)
-    jax.block_until_ready(state.theta)
-    dt = time.perf_counter() - t0
-    return repeats * K / dt, learner, batches, epoch, K
+    windows = min(windows, max(repeats, 1))
+    per_window = max(repeats // windows, 1)
+
+    def run_window():
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(per_window):
+            state, _ = learner.run_train_iters(state, batches, epoch=epoch)
+        jax.block_until_ready(state.theta)
+        return per_window * K, time.perf_counter() - t0
+
+    median, peak, mean = _windowed_rates(windows, run_window)
+    return median, peak, mean, learner, batches, epoch, K
 
 
 def _flops_per_iter(learner, state_template, batches, epoch, K):
@@ -128,14 +176,22 @@ def _measure_real_data(seconds: float = 12.0):
             state, _ = learner.run_train_iter(state, (x_s, x_t, y_s, y_t), epoch)
         jax.block_until_ready(state.theta)
 
-        n = 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < seconds:
+        # Median of REAL_DATA_WINDOWS time-boxed windows (contention
+        # rationale in _windowed_rates' docstring).
+        def step_one():
+            nonlocal state
             x_s, x_t, y_s, y_t, _seed = next(gen)
             state, _ = learner.run_train_iter(state, (x_s, x_t, y_s, y_t), epoch)
-            n += 1
-        jax.block_until_ready(state.theta)
-        per_iter = n / (time.perf_counter() - t0)
+            return 1
+
+        per_iter, _, _ = _windowed_rates(
+            REAL_DATA_WINDOWS,
+            _time_boxed_window(
+                seconds / REAL_DATA_WINDOWS,
+                step_one,
+                lambda: jax.block_until_ready(state.theta),
+            ),
+        )
 
         # K-iteration scan dispatch over the same live pipeline
         # (--iters_per_dispatch mode): amortizes per-dispatch latency, so
@@ -146,14 +202,21 @@ def _measure_real_data(seconds: float = 12.0):
             chunk = [next(gen)[:4] for _ in range(K)]
             state, _ = learner.run_train_iters(state, chunk, epoch)  # compile
             jax.block_until_ready(state.theta)
-            n = 0
-            t0 = time.perf_counter()
-            while time.perf_counter() - t0 < seconds:
+
+            def step_chunk():
+                nonlocal state
                 chunk = [next(gen)[:4] for _ in range(K)]
                 state, _ = learner.run_train_iters(state, chunk, epoch)
-                n += K
-            jax.block_until_ready(state.theta)
-            per_chunk = n / (time.perf_counter() - t0)
+                return K
+
+            per_chunk, _, _ = _windowed_rates(
+                REAL_DATA_WINDOWS,
+                _time_boxed_window(
+                    seconds / REAL_DATA_WINDOWS,
+                    step_chunk,
+                    lambda: jax.block_until_ready(state.theta),
+                ),
+            )
         except Exception as exc:  # noqa: BLE001 — observability extra only
             print(f"# K-dispatch real-data measurement unavailable: {exc}",
                   file=sys.stderr)
@@ -166,7 +229,7 @@ def _measure_real_data(seconds: float = 12.0):
 
 def main() -> None:
     cfg = _flagship_config()
-    value, learner, batches, epoch, K = _measure(cfg)
+    value, peak, sustained, learner, batches, epoch, K = _measure(cfg)
 
     # MFU: measured iters/s x FLOPs/iter / chip peak.
     mfu = None
@@ -174,17 +237,17 @@ def main() -> None:
     flops = _flops_per_iter(learner, state_template, batches, epoch, K)
     if flops:
         kind = jax.devices()[0].device_kind
-        peak = next(
+        chip_peak_flops = next(
             (v for k, v in PEAK_FLOPS_BY_KIND.items() if k in kind),
             PEAK_FLOPS_BY_KIND["TPU v5 lite"],
         )
-        mfu = value * flops / peak
+        mfu = value * flops / chip_peak_flops
 
     # bf16 variant (params/stats fp32, backbone compute bf16 on the MXU).
     import dataclasses
 
     bf16_cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
-    bf16_value, *_ = _measure(bf16_cfg, repeats=20)
+    bf16_value, *_rest = _measure(bf16_cfg, repeats=20)
 
     real = _measure_real_data()
     real_per_iter, real_k25 = real if real is not None else (None, None)
@@ -196,6 +259,11 @@ def main() -> None:
                 "value": round(value, 4),
                 "unit": "meta-iters/s",
                 "vs_baseline": round(value / BASELINE_META_ITERS_PER_S, 2),
+                # value = median timing window (robust to tunnel-contention
+                # dips, no max-selection bias; _windowed_rates); peak and
+                # all-window mean alongside for transparency.
+                "peak_meta_iters_per_s": round(peak, 4),
+                "sustained_meta_iters_per_s": round(sustained, 4),
                 "mfu": round(mfu, 6) if mfu is not None else None,
                 "bf16_meta_iters_per_s": round(bf16_value, 4),
                 "real_data_meta_iters_per_s": (
