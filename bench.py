@@ -71,23 +71,27 @@ def _windowed_rates(windows, run_window):
     return statistics.median(rates), max(rates), total_units / total_dt
 
 
-def _time_boxed_window(budget_s, step, drain):
+def _time_boxed_window(budget_s, step, drain, clock=time.perf_counter):
     """Build a ``run_window`` for _windowed_rates that keeps calling
     ``step() -> units`` (async dispatch) for ``budget_s`` seconds, then
     ``drain()``s the device queue before the window's clock stops."""
 
     def run_window():
         n = 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < budget_s:
+        t0 = clock()
+        while clock() - t0 < budget_s:
             n += step()
         drain()
-        return n, time.perf_counter() - t0
+        return n, clock() - t0
 
     return run_window
 
 
-def _measure(cfg, repeats=40, K=DISPATCH_CHUNK, windows=5):
+def _measure(cfg, repeats=100, K=DISPATCH_CHUNK, windows=5):
+    """``repeats`` is the MINIMUM number of K-iteration dispatches measured;
+    it is rounded UP to fill ``windows`` equal windows. Windows must be long
+    (hundreds of ms) relative to the one drain round-trip each pays, or the
+    per-window sync deflates the rate."""
     from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
 
     learner = MAMLFewShotLearner(cfg)
@@ -101,7 +105,7 @@ def _measure(cfg, repeats=40, K=DISPATCH_CHUNK, windows=5):
     jax.block_until_ready(state.theta)
 
     windows = min(windows, max(repeats, 1))
-    per_window = max(repeats // windows, 1)
+    per_window = -(-repeats // windows)  # ceil: repeats is a floor, not a cap
 
     def run_window():
         nonlocal state
@@ -247,7 +251,7 @@ def main() -> None:
     import dataclasses
 
     bf16_cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
-    bf16_value, *_rest = _measure(bf16_cfg, repeats=20)
+    bf16_value, *_rest = _measure(bf16_cfg, repeats=50)
 
     real = _measure_real_data()
     real_per_iter, real_k25 = real if real is not None else (None, None)

@@ -1,0 +1,43 @@
+"""Unit tests for bench.py's timing helpers (the driver-gate script).
+
+bench's measurement functions need a TPU + datasets, but the windowing
+math they share is plain Python — covered here so a refactor can't
+silently change the reported statistic (the driver records bench output
+as the round's official number).
+"""
+
+import bench
+
+
+def test_windowed_rates_median_peak_mean():
+    windows = iter([(10, 1.0), (10, 2.0), (10, 4.0)])  # 10, 5, 2.5 u/s
+    median, peak, mean = bench._windowed_rates(3, lambda: next(windows))
+    assert median == 5.0
+    assert peak == 10.0
+    # mean is duration-weighted: 30 units over 7 s
+    assert abs(mean - 30 / 7.0) < 1e-12
+
+
+def test_windowed_rates_even_count_is_true_median():
+    # Even window counts must interpolate, not pick the upper-middle value
+    # (upper-middle would re-introduce an upward bias under one-sided
+    # contention dips).
+    windows = iter([(10, 1.0), (10, 1.0), (10, 2.0), (10, 2.0)])
+    median, _, _ = bench._windowed_rates(4, lambda: next(windows))
+    assert median == 7.5  # (10 + 5) / 2
+
+
+def test_time_boxed_window_counts_units_and_drains():
+    drained = []
+    ticks = iter(x * 0.25 for x in range(100))
+    run = bench._time_boxed_window(
+        1.0,
+        step=lambda: 3,
+        drain=lambda: drained.append(True),
+        clock=lambda: next(ticks),
+    )
+    units, dt = run()
+    # clock: t0=0.0; loop checks at 0.25,0.5,0.75 (3 steps run), stops at 1.0
+    assert units == 9
+    assert drained == [True]
+    assert dt > 0
