@@ -1,6 +1,7 @@
 """Model families: conv backbone, MAML/MAML++ learner, baselines."""
 
-from .backbone import BackboneConfig, VGGBackbone
+from .backbone import BackboneConfig, VGGBackbone, build_backbone
+from .resnet import ResNet12Backbone
 from .maml import MAMLConfig, MAMLFewShotLearner
 from .gradient_descent import GradientDescentLearner
 from .matching_nets import MatchingNetsLearner
@@ -8,6 +9,8 @@ from .matching_nets import MatchingNetsLearner
 __all__ = [
     "BackboneConfig",
     "VGGBackbone",
+    "ResNet12Backbone",
+    "build_backbone",
     "MAMLConfig",
     "MAMLFewShotLearner",
     "GradientDescentLearner",

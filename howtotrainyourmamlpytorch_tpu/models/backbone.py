@@ -55,8 +55,16 @@ Params = dict[str, Any]
 class BackboneConfig:
     """Static architecture hyperparameters (all config-derived)."""
 
+    # "vgg" — the reference's VGGReLUNormNetwork shape
+    # (meta_neural_network_architectures.py:542-684); "resnet12" — the
+    # standard few-shot ResNet-12 (BASELINE.json config #4: CIFAR-FS/FC100),
+    # built in models/resnet.py with the same per-step-BN machinery.
+    architecture: str = "vgg"
     num_stages: int = 4
     num_filters: int = 64
+    # ResNet-12 stage widths; None = num_filters x (1, 2, 4, 8). The
+    # MetaOptNet/TADAM variant is (64, 160, 320, 640).
+    resnet_widths: tuple[int, int, int, int] | None = None
     kernel_size: int = 3
     conv_padding: int = 1  # int(bool) like the reference's conv_padding flag
     max_pooling: bool = True
@@ -105,6 +113,11 @@ class BackboneConfig:
     @property
     def feature_dim(self) -> int:
         """Flattened feature size entering the linear head."""
+        if self.architecture == "resnet12":
+            # Global average pool over the last stage (models/resnet.py).
+            if self.resnet_widths is not None:
+                return self.resnet_widths[-1]
+            return 8 * self.num_filters
         if self.max_pooling:
             h, w = self.stage_spatial_shapes()[-1]
             return self.num_filters * h * w
@@ -306,46 +319,11 @@ class VGGBackbone:
         return logits, new_bn_state
 
     def _fused_norm_act(self, x, gamma, beta, state, step):
-        """Pallas fused bn+leaky_relu + the same running-stat update as
-        ``ops/norm.batch_norm`` (torch semantics: unbiased var, momentum
-        mix), with per-step row select/scatter."""
-        import jax.numpy as jnp
-
-        from ..ops.norm import BatchNormState
-        from ..ops.pallas_fused_norm import fused_bn_leaky_relu
-
         cfg = self.cfg
-        step = jnp.asarray(step)
-        if gamma.ndim == 2:
-            s = jnp.minimum(step, gamma.shape[0] - 1)
-            gamma_row, beta_row = gamma[s], beta[s]
-        else:
-            gamma_row, beta_row = gamma, beta
-        # Interpreter mode off-TPU (CPU tests); real kernels otherwise.
-        interpret = jax.default_backend() == "cpu"
-        out, mean, var = fused_bn_leaky_relu(
-            x, gamma_row.astype(jnp.float32), beta_row.astype(jnp.float32),
-            cfg.bn_eps, 0.01, interpret,
+        return fused_norm_act(
+            x, gamma, beta, state, step,
+            eps=cfg.bn_eps, momentum=cfg.bn_momentum,
         )
-        n = x.shape[0] * x.shape[2] * x.shape[3]
-        var_unbiased = var * (n / max(n - 1, 1))
-        m = cfg.bn_momentum
-        if state.running_mean.ndim == 2:
-            s = jnp.minimum(step, state.running_mean.shape[0] - 1)
-            new_state = BatchNormState(
-                running_mean=state.running_mean.at[s].set(
-                    (1.0 - m) * state.running_mean[s] + m * mean
-                ),
-                running_var=state.running_var.at[s].set(
-                    (1.0 - m) * state.running_var[s] + m * var_unbiased
-                ),
-            )
-        else:
-            new_state = BatchNormState(
-                running_mean=(1.0 - m) * state.running_mean + m * mean,
-                running_var=(1.0 - m) * state.running_var + m * var_unbiased,
-            )
-        return out, new_state
 
     # ------------------------------------------------------------------
     # Inner-loop parameter partition
@@ -370,3 +348,54 @@ def _map_with_path(fn, tree: Params, path: tuple[str, ...] = ()) -> Params:
     if isinstance(tree, dict):
         return {k: _map_with_path(fn, v, path + (k,)) for k, v in tree.items()}
     return fn(path, tree)
+
+
+def fused_norm_act(x, gamma, beta, state, step, *, eps, momentum, slope=0.01):
+    """Pallas fused bn+leaky_relu + the same running-stat update as
+    ``ops/norm.batch_norm`` (torch semantics: unbiased var, momentum mix),
+    with per-step row select/scatter. Shared by the VGG and ResNet-12
+    backbones; one-level-AD only (see ``use_pallas_fused_norm``)."""
+    from ..ops.pallas_fused_norm import fused_bn_leaky_relu
+
+    step = jnp.asarray(step)
+    if gamma.ndim == 2:
+        s = jnp.minimum(step, gamma.shape[0] - 1)
+        gamma_row, beta_row = gamma[s], beta[s]
+    else:
+        gamma_row, beta_row = gamma, beta
+    # Interpreter mode off-TPU (CPU tests); real kernels otherwise.
+    interpret = jax.default_backend() == "cpu"
+    out, mean, var = fused_bn_leaky_relu(
+        x, gamma_row.astype(jnp.float32), beta_row.astype(jnp.float32),
+        eps, slope, interpret,
+    )
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    var_unbiased = var * (n / max(n - 1, 1))
+    m = momentum
+    if state.running_mean.ndim == 2:
+        s = jnp.minimum(step, state.running_mean.shape[0] - 1)
+        new_state = BatchNormState(
+            running_mean=state.running_mean.at[s].set(
+                (1.0 - m) * state.running_mean[s] + m * mean
+            ),
+            running_var=state.running_var.at[s].set(
+                (1.0 - m) * state.running_var[s] + m * var_unbiased
+            ),
+        )
+    else:
+        new_state = BatchNormState(
+            running_mean=(1.0 - m) * state.running_mean + m * mean,
+            running_var=(1.0 - m) * state.running_var + m * var_unbiased,
+        )
+    return out, new_state
+
+
+def build_backbone(cfg: BackboneConfig):
+    """Architecture dispatch: the factory every learner builds through."""
+    if cfg.architecture == "vgg":
+        return VGGBackbone(cfg)
+    if cfg.architecture == "resnet12":
+        from .resnet import ResNet12Backbone
+
+        return ResNet12Backbone(cfg)
+    raise ValueError(f"unknown backbone architecture {cfg.architecture!r}")

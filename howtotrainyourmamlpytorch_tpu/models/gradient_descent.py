@@ -34,7 +34,7 @@ import optax
 from jax import lax
 
 from ..ops import accuracy, cross_entropy
-from .backbone import VGGBackbone
+from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
@@ -59,7 +59,7 @@ class GradientDescentLearner(CheckpointableLearner):
 
     def __init__(self, cfg: MAMLConfig, mesh=None):
         self.cfg = cfg
-        self.backbone = VGGBackbone(cfg.backbone)
+        self.backbone = build_backbone(cfg.backbone)
         self.current_epoch = 0
         self.mesh = mesh
         # Single Adam over the shared weights; LR set per-iteration from the

@@ -45,7 +45,7 @@ from jax import lax
 from ..inner_loop import init_lslr, lslr_update
 from ..ops import accuracy, cross_entropy
 from ..utils.trees import merge, partition
-from .backbone import BackboneConfig, VGGBackbone
+from .backbone import BackboneConfig, build_backbone
 from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
@@ -183,7 +183,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
 
     def __init__(self, cfg: MAMLConfig, mesh: jax.sharding.Mesh | None = None):
         self.cfg = cfg
-        self.backbone = VGGBackbone(cfg.backbone)
+        self.backbone = build_backbone(cfg.backbone)
         self.tx = self._make_optimizer()
         self.mesh = mesh
         self.current_epoch = 0

@@ -43,7 +43,7 @@ import optax
 from jax import lax
 
 from ..ops import accuracy
-from .backbone import VGGBackbone
+from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
@@ -91,7 +91,7 @@ class MatchingNetsLearner(CheckpointableLearner):
     def __init__(self, cfg: MAMLConfig, mesh=None, parity_bug: bool = False):
         self.cfg = cfg
         self.parity_bug = parity_bug
-        self.backbone = VGGBackbone(cfg.backbone)
+        self.backbone = build_backbone(cfg.backbone)
         self.current_epoch = 0
         self.mesh = mesh
         self.tx = make_injected_adam(cfg.meta_learning_rate, cfg.clip_grad_value)

@@ -1,0 +1,225 @@
+"""ResNet-12 backbone for few-shot classification (BASELINE.json config #4:
+CIFAR-FS / FC100 with ResNet-12, 5 inner steps).
+
+The reference repo has no residual backbone — its only network is the 4-stage
+VGG-style conv net (``meta_neural_network_architectures.py:542-684``). This
+module extends the framework beyond reference parity with the standard
+few-shot ResNet-12 (TADAM / MetaOptNet): four residual stages, each
+
+    3x (3x3 conv -> BN -> LeakyReLU(0.1))   [activation after the 3rd conv
+    + 1x1-conv/BN projection shortcut        is applied to the sum]
+    -> 2x2 max pool
+
+followed by a global average pool and a linear head. Stage widths default to
+``num_filters x (1, 2, 4, 8)``; ``resnet_widths`` selects e.g. the
+MetaOptNet ``(64, 160, 320, 640)`` variant.
+
+MAML++ machinery carries over unchanged: every BN site supports per-step
+statistics and per-step gamma/beta (``ops/norm.batch_norm``), the inner-loop
+mask excludes norm parameters exactly like the VGG backbone, and parameter
+leaves keep the ``.../conv/weight`` / ``.../norm/{gamma,beta}`` path shape so
+``parallel/mesh.param_shardings`` shards conv filters over ``mp`` without new
+rules.
+
+Parameter tree layout::
+
+    params = {
+      "res0": {
+        "conv0": {"conv": {"weight", "bias"}, "norm": {"gamma", "beta"}},
+        "conv1": {...}, "conv2": {...},
+        "shortcut": {"conv": {"weight", "bias"}, "norm": {"gamma", "beta"}},
+      },
+      ..., "linear": {"weight", "bias"},
+    }
+    bn_state = {"res0": {"conv0": BatchNormState, ..., "shortcut": ...}, ...}
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import batch_norm, conv2d, linear, max_pool2d, xavier_uniform
+from ..ops.norm import init_batch_norm_state
+from .backbone import BackboneConfig, Params, _map_with_path, fused_norm_act
+
+LEAKY_SLOPE = 0.1  # few-shot ResNet-12 convention (vs the VGG net's 0.01)
+
+
+class ResNet12Backbone:
+    """Pure-functional ResNet-12: same interface as ``VGGBackbone``."""
+
+    NUM_STAGES = 4
+    CONVS_PER_STAGE = 3
+
+    def __init__(self, cfg: BackboneConfig):
+        if cfg.norm_layer != "batch_norm":
+            raise ValueError(
+                "resnet12 supports norm_layer='batch_norm' only "
+                f"(got {cfg.norm_layer!r})"
+            )
+        if cfg.resnet_widths is not None and len(cfg.resnet_widths) != self.NUM_STAGES:
+            raise ValueError(
+                f"resnet_widths needs exactly {self.NUM_STAGES} stage widths "
+                f"(got {cfg.resnet_widths!r})"
+            )
+        self.cfg = cfg
+
+    @property
+    def widths(self) -> tuple[int, int, int, int]:
+        if self.cfg.resnet_widths is not None:
+            return tuple(self.cfg.resnet_widths)
+        f = self.cfg.num_filters
+        return (f, 2 * f, 4 * f, 8 * f)
+
+    @property
+    def feature_dim(self) -> int:
+        return self.widths[-1]
+
+    # ------------------------------------------------------------------
+    # Init
+    # ------------------------------------------------------------------
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> tuple[Params, Params]:
+        """Initializes ``(params, bn_state)``: Xavier-uniform convs, zero
+        biases, BN gamma ones / beta zeros (framework-wide init convention,
+        matching the reference's choices for its own backbone)."""
+        cfg = self.cfg
+        params: Params = {}
+        bn_state: Params = {}
+        in_ch = cfg.image_channels
+        keys = jax.random.split(key, self.NUM_STAGES * 4 + 1)
+        k = iter(keys)
+
+        affine_shape = (
+            (lambda f: (cfg.num_steps, f)) if cfg.per_step_affine else (lambda f: (f,))
+        )
+
+        def conv_unit(key, in_c, out_c, ksize):
+            return {
+                "conv": {
+                    "weight": xavier_uniform(key, (out_c, in_c, ksize, ksize), dtype),
+                    "bias": jnp.zeros((out_c,), dtype),
+                },
+                "norm": {
+                    "gamma": jnp.ones(affine_shape(out_c), dtype),
+                    "beta": jnp.zeros(affine_shape(out_c), dtype),
+                },
+            }
+
+        steps = cfg.num_steps if cfg.per_step_bn_statistics else None
+        for i, width in enumerate(self.widths):
+            stage: Params = {}
+            stage_state: Params = {}
+            c = in_ch
+            for j in range(self.CONVS_PER_STAGE):
+                stage[f"conv{j}"] = conv_unit(next(k), c, width, 3)
+                stage_state[f"conv{j}"] = init_batch_norm_state(width, steps, dtype)
+                c = width
+            stage["shortcut"] = conv_unit(next(k), in_ch, width, 1)
+            stage_state["shortcut"] = init_batch_norm_state(width, steps, dtype)
+            params[f"res{i}"] = stage
+            bn_state[f"res{i}"] = stage_state
+            in_ch = width
+
+        params["linear"] = {
+            "weight": xavier_uniform(next(k), (cfg.num_classes, self.feature_dim), dtype),
+            "bias": jnp.zeros((cfg.num_classes,), dtype),
+        }
+        return params, bn_state
+
+    # ------------------------------------------------------------------
+    # Forward
+    # ------------------------------------------------------------------
+
+    def apply(
+        self,
+        params: Params,
+        bn_state: Params,
+        x: jax.Array,
+        step,
+        *,
+        training: bool = True,
+        fused: bool | None = None,
+    ) -> tuple[jax.Array, Params]:
+        """Forward pass ``(N, C, H, W) -> (logits, new_bn_state)``.
+
+        Like the VGG backbone (and the reference's always-``training=True``
+        BN call), normalization uses the current batch statistics in every
+        phase; the returned state is diagnostic. The Pallas fused
+        bn+leaky_relu kernel covers the two adjacent bn->activation pairs
+        inside each stage (conv0/conv1); conv2's BN feeds the residual add
+        and the shortcut BN is unactivated, so both always take the lax path.
+        """
+        del training
+        cfg = self.cfg
+        use_fused = cfg.use_pallas_fused_norm if fused is None else fused
+        new_bn_state: Params = {}
+        out = x
+
+        def norm(h, unit, state, *, activate):
+            if use_fused and activate:
+                return fused_norm_act(
+                    h, unit["norm"]["gamma"], unit["norm"]["beta"], state, step,
+                    eps=cfg.bn_eps, momentum=cfg.bn_momentum, slope=LEAKY_SLOPE,
+                )
+            h, new_state = batch_norm(
+                h, unit["norm"]["gamma"], unit["norm"]["beta"], state, step,
+                momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+            )
+            if activate:
+                h = jax.nn.leaky_relu(h, negative_slope=LEAKY_SLOPE)
+            return h, new_state
+
+        for i in range(self.NUM_STAGES):
+            stage = params[f"res{i}"]
+            state = bn_state[f"res{i}"]
+            new_state: Params = {}
+            identity = out
+
+            h = out
+            for j in range(self.CONVS_PER_STAGE):
+                unit = stage[f"conv{j}"]
+                h = conv2d(
+                    h, unit["conv"]["weight"], unit["conv"]["bias"],
+                    stride=1, padding=1,
+                )
+                last = j == self.CONVS_PER_STAGE - 1
+                h, new_state[f"conv{j}"] = norm(
+                    h, unit, state[f"conv{j}"], activate=not last
+                )
+
+            sc = conv2d(
+                identity,
+                stage["shortcut"]["conv"]["weight"],
+                stage["shortcut"]["conv"]["bias"],
+                stride=1, padding=0,
+            )
+            sc, new_state["shortcut"] = norm(
+                sc, stage["shortcut"], state["shortcut"], activate=False
+            )
+
+            out = jax.nn.leaky_relu(h + sc, negative_slope=LEAKY_SLOPE)
+            out = max_pool2d(out, 2, 2)
+            new_bn_state[f"res{i}"] = new_state
+
+        # Global average pool over whatever spatial extent remains.
+        out = jnp.mean(out.astype(jnp.float32), axis=(2, 3)).astype(out.dtype)
+        logits = linear(out, params["linear"]["weight"], params["linear"]["bias"])
+        return logits, new_bn_state
+
+    # ------------------------------------------------------------------
+    # Inner-loop parameter partition
+    # ------------------------------------------------------------------
+
+    def inner_loop_mask(self, params: Params) -> Params:
+        """Same rule as the VGG backbone / the reference's
+        ``get_inner_loop_parameter_dict`` (``few_shot_learning_system.py:
+        105-120``): adapt everything except norm parameters unless
+        ``enable_inner_loop_optimizable_bn_params``."""
+        enable_bn = self.cfg.enable_inner_loop_optimizable_bn_params
+
+        def mark(path: tuple[str, ...], _leaf) -> bool:
+            return enable_bn or "norm" not in path
+
+        return _map_with_path(mark, params)

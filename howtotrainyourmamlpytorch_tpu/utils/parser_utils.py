@@ -130,6 +130,9 @@ def get_parser() -> argparse.ArgumentParser:
         help="when set, jax.profiler-trace the first profile_num_iters "
              "train iterations into this directory")
     add("--profile_num_iters", type=int, default=20)
+    add("--resnet_widths", nargs="+", type=int, default=None,
+        help="4 stage widths for architecture_name=resnet12 (default "
+             "cnn_num_filters x 1/2/4/8; MetaOptNet uses 64 160 320 640)")
     return parser
 
 
@@ -198,7 +201,26 @@ def args_to_maml_config(args):
     pair consumed by the learners (flag semantics per SURVEY §5 C19)."""
     from ..models import BackboneConfig, MAMLConfig
 
+    # The reference declares --architecture_name but never reads it
+    # (utils/parser_utils.py:21 there); here it selects the backbone family.
+    # Unknown names fail fast rather than silently training the default net.
+    arch_raw = (getattr(args, "architecture_name", None) or "").lower()
+    known = {
+        "": "vgg",
+        "vgg": "vgg",
+        "vggrelunormnetwork": "vgg",
+        "resnet12": "resnet12",
+        "resnet-12": "resnet12",
+    }
+    if arch_raw not in known:
+        raise ValueError(
+            f"unknown architecture_name {arch_raw!r}; expected one of {sorted(known)}"
+        )
+    architecture = known[arch_raw]
+    widths = getattr(args, "resnet_widths", None)
     backbone = BackboneConfig(
+        architecture=architecture,
+        resnet_widths=tuple(int(w) for w in widths) if widths else None,
         num_stages=int(args.num_stages),
         num_filters=int(args.cnn_num_filters),
         conv_padding=int(bool(args.conv_padding)),

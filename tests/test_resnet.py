@@ -1,0 +1,217 @@
+"""ResNet-12 backbone tests (BASELINE.json config #4 architecture).
+
+The reference has no residual backbone, so there is no parity target; these
+tests pin the architecture's structure (shapes, residual path, per-step BN
+threading), its behavior under the MAML meta-gradient (second order through
+the scan), and its integration surface (config mapping, optimizer masks,
+mesh sharding rules).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    ResNet12Backbone,
+    build_backbone,
+)
+
+
+def resnet_cfg(**kw):
+    defaults = dict(
+        architecture="resnet12",
+        num_filters=4,
+        num_classes=3,
+        image_channels=3,
+        image_height=16,
+        image_width=16,
+        per_step_bn_statistics=True,
+        num_steps=2,
+    )
+    defaults.update(kw)
+    return BackboneConfig(**defaults)
+
+
+def maml_cfg(**kw):
+    defaults = dict(
+        backbone=resnet_cfg(),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_iter_per_epoch=4,
+        total_epochs=3,
+    )
+    defaults.update(kw)
+    return MAMLConfig(**defaults)
+
+
+def tiny_batch(rng, b=2, n=3, k=2, t=2, c=3, h=16, w=16):
+    xs = rng.randn(b, n, k, c, h, w).astype(np.float32)
+    xt = rng.randn(b, n, t, c, h, w).astype(np.float32)
+    ys = np.tile(np.arange(n)[None, :, None], (b, 1, k)).astype(np.float32)
+    yt = np.tile(np.arange(n)[None, :, None], (b, 1, t)).astype(np.float32)
+    return xs, xt, ys, yt
+
+
+def test_factory_dispatch():
+    assert isinstance(build_backbone(resnet_cfg()), ResNet12Backbone)
+    with pytest.raises(ValueError):
+        build_backbone(resnet_cfg(architecture="nope"))
+    with pytest.raises(ValueError):
+        build_backbone(resnet_cfg(norm_layer="layer_norm"))
+
+
+def test_forward_shapes_and_structure():
+    bb = build_backbone(resnet_cfg())
+    params, bn = bb.init(jax.random.key(0))
+    assert bb.widths == (4, 8, 16, 32)
+    assert bb.feature_dim == 32
+    # 4 stages x (3 convs + shortcut), each {conv: w+b, norm: gamma+beta},
+    # plus the linear head.
+    assert len(jax.tree.leaves(params)) == 4 * 4 * 4 + 2
+    logits, new_bn = bb.apply(params, bn, jnp.ones((5, 3, 16, 16)), 0)
+    assert logits.shape == (5, 3)
+    # Per-step BN arrays: (S, F) rows, step 0 written, step 1 untouched.
+    st = new_bn["res0"]["conv0"]
+    assert st.running_mean.shape == (2, 4)
+    assert not np.allclose(st.running_mean[0], 0.0)
+    assert np.allclose(st.running_mean[1], 0.0)
+
+
+def test_explicit_widths():
+    bb = build_backbone(resnet_cfg(resnet_widths=(4, 6, 8, 10)))
+    params, bn = bb.init(jax.random.key(0))
+    assert bb.widths == (4, 6, 8, 10)
+    assert params["res2"]["conv0"]["conv"]["weight"].shape == (8, 6, 3, 3)
+    logits, _ = bb.apply(params, bn, jnp.ones((2, 3, 16, 16)), 0)
+    assert logits.shape == (2, 3)
+
+
+def test_residual_path_contributes():
+    """Zeroing the conv trunk must still propagate the input via the
+    shortcut: logits respond to the input through the projection path."""
+    bb = build_backbone(resnet_cfg(per_step_bn_statistics=False))
+    params, bn = bb.init(jax.random.key(0))
+    # Zero only the trunk convs; keep shortcuts and the head.
+    zeroed = {k: dict(v) for k, v in params.items() if k != "linear"}
+    zeroed["linear"] = params["linear"]
+    for i in range(4):
+        for j in range(3):
+            zeroed[f"res{i}"][f"conv{j}"] = jax.tree.map(
+                jnp.zeros_like, params[f"res{i}"][f"conv{j}"]
+            )
+    r = np.random.RandomState(3)
+    x1 = jnp.asarray(r.randn(2, 3, 16, 16), jnp.float32)
+    x2 = jnp.asarray(r.randn(2, 3, 16, 16), jnp.float32)
+    l1, _ = bb.apply(zeroed, bn, x1, 0)
+    l2, _ = bb.apply(zeroed, bn, x2, 0)
+    assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+
+def test_inner_loop_mask_excludes_norm():
+    bb = build_backbone(resnet_cfg())
+    params, _ = bb.init(jax.random.key(0))
+    mask = bb.inner_loop_mask(params)
+    assert mask["res0"]["conv0"]["conv"]["weight"] is True
+    assert mask["res0"]["conv0"]["norm"]["gamma"] is False
+    assert mask["res0"]["shortcut"]["norm"]["beta"] is False
+    assert mask["linear"]["weight"] is True
+    mask_bn = build_backbone(
+        resnet_cfg(enable_inner_loop_optimizable_bn_params=True)
+    ).inner_loop_mask(params)
+    assert mask_bn["res0"]["conv0"]["norm"]["gamma"] is True
+
+
+def test_second_order_maml_train_decreases_loss(rng):
+    learner = MAMLFewShotLearner(maml_cfg(second_order=True))
+    state = learner.init_state(jax.random.key(0))
+    batch = tiny_batch(rng)
+    losses = []
+    for _ in range(8):
+        state, metrics = learner.run_train_iter(state, batch, epoch=0)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_eval_contract_and_bn_state_untouched(rng):
+    learner = MAMLFewShotLearner(maml_cfg())
+    state = learner.init_state(jax.random.key(0))
+    before = jax.tree.map(np.asarray, state.bn_state)
+    _, losses, _ = learner.run_validation_iter(state, tiny_batch(rng))
+    assert np.isfinite(float(losses["loss"]))
+    after = state.bn_state
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        before, after,
+    )
+
+
+def test_args_mapping_selects_resnet(monkeypatch, tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+        args_to_maml_config, get_parser, Bunch,
+    )
+
+    args = Bunch(vars(get_parser().parse_args([
+        "--architecture_name", "ResNet12",
+        "--resnet_widths", "8", "16", "32", "64",
+        "--num_classes_per_set", "5",
+        "--per_step_bn_statistics", "True",
+        "--number_of_training_steps_per_iter", "5",
+        "--number_of_evaluation_steps_per_iter", "5",
+    ])))
+    args.per_step_bn_statistics = True
+    cfg = args_to_maml_config(args)
+    assert cfg.backbone.architecture == "resnet12"
+    assert cfg.backbone.resnet_widths == (8, 16, 32, 64)
+    assert isinstance(build_backbone(cfg.backbone), ResNet12Backbone)
+    # Default (architecture_name unset) stays VGG.
+    args2 = Bunch(vars(get_parser().parse_args([])))
+    assert args_to_maml_config(args2).backbone.architecture == "vgg"
+
+
+def test_mp_sharding_rules_cover_resnet_tree():
+    """parallel/mesh.param_shardings must shard resnet conv filters over mp
+    and BN affine rows over their feature axis without new rules."""
+    from jax.sharding import PartitionSpec as P
+
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        make_mesh, param_shardings,
+    )
+
+    bb = build_backbone(resnet_cfg(num_filters=4))
+    params, _ = bb.init(jax.random.key(0))
+    mesh = make_mesh(jax.devices()[:4], data_parallel=2, model_parallel=2)
+    shardings = param_shardings(mesh, params, shard_model=True)
+    assert shardings["res0"]["conv0"]["conv"]["weight"].spec == P("mp", None, None, None)
+    assert shardings["res0"]["conv0"]["norm"]["gamma"].spec == P(None, "mp")
+    assert shardings["res0"]["shortcut"]["conv"]["weight"].spec == P("mp", None, None, None)
+    assert shardings["linear"]["weight"].spec == P(None, "mp")
+
+
+def test_dp_sharded_train_iter_runs(rng):
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:2], data_parallel=2, model_parallel=1)
+    learner = MAMLFewShotLearner(maml_cfg(), mesh=mesh)
+    state = learner.init_state(jax.random.key(0))
+    state, metrics = learner.run_train_iter(state, tiny_batch(rng, b=2), epoch=0)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_config_validation_fails_fast():
+    with pytest.raises(ValueError):
+        build_backbone(resnet_cfg(resnet_widths=(4, 6, 8)))
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+        args_to_maml_config, get_parser, Bunch,
+    )
+    args = Bunch(vars(get_parser().parse_args(
+        ["--architecture_name", "restnet12"]
+    )))
+    with pytest.raises(ValueError):
+        args_to_maml_config(args)
+    assert resnet_cfg(num_filters=4).feature_dim == 32
+    assert resnet_cfg(resnet_widths=(4, 6, 8, 10)).feature_dim == 10
