@@ -46,23 +46,36 @@ def _random_crop(image: np.ndarray, size: int, padding: int, rng) -> np.ndarray:
     return padded[top : top + size, left : left + size]
 
 
-def get_transforms_for_dataset(dataset_name: str, args, k: int):
+def get_transforms_for_dataset(
+    dataset_name: str, args, k: int, defer_normalization: bool = False
+):
     """Returns ``(train_transforms, eval_transforms)`` — lists of callables
-    ``(hwc_image, rng) -> hwc_image`` (``data.py:80-108``)."""
+    ``(hwc_image, rng) -> hwc_image`` (``data.py:80-108``).
+
+    ``defer_normalization`` drops the mean/std step: the uint8 wire codec
+    (``--transfer_dtype uint8``) applies it on the device instead, so host
+    pixels must stay at k/255 (models/common.WireCodec)."""
     if "cifar10" in dataset_name or "cifar100" in dataset_name:
         mean = np.asarray(args.classification_mean, np.float32)
         std = np.asarray(args.classification_std, np.float32)
         train = [
             lambda im, rng: _random_crop(im, 32, 4, rng),
             lambda im, rng: im[:, ::-1] if rng.rand() < 0.5 else im,
-            lambda im, rng: _normalize(im, mean, std),
         ]
-        evaluate = [lambda im, rng: _normalize(im, mean, std)]
+        evaluate = []
+        if not defer_normalization:
+            train.append(lambda im, rng: _normalize(im, mean, std))
+            evaluate.append(lambda im, rng: _normalize(im, mean, std))
     elif "omniglot" in dataset_name:
         train = [lambda im, rng, k=k: rotate_image(im, k)]
         evaluate = []
     elif "imagenet" in dataset_name:
-        train = [lambda im, rng: _normalize(im, IMAGENET_MEAN, IMAGENET_STD)]
+        if defer_normalization:
+            train = []
+        else:
+            train = [
+                lambda im, rng: _normalize(im, IMAGENET_MEAN, IMAGENET_STD)
+            ]
         evaluate = list(train)
     else:
         train, evaluate = [], []
@@ -77,13 +90,16 @@ def augment_image(
     args,
     dataset_name: str,
     rng: np.random.RandomState,
+    defer_normalization: bool = False,
 ) -> np.ndarray:
     """Applies the dataset's train/eval transform chain to one HWC image and
     returns CHW float32 (the reference's trailing ``ToTensor``,
     ``data.py:55-77``). ``rng`` drives the stochastic transforms (crop/flip)
     and must come from the episode's deterministic RandomState."""
     del channels
-    train, evaluate = get_transforms_for_dataset(dataset_name, args, k)
+    train, evaluate = get_transforms_for_dataset(
+        dataset_name, args, k, defer_normalization
+    )
     for fn in train if augment_bool else evaluate:
         image = fn(image, rng)
     return np.ascontiguousarray(np.transpose(image, (2, 0, 1)).astype(np.float32))

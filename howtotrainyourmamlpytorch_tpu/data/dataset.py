@@ -62,6 +62,9 @@ class FewShotLearningDataset:
     # Per-dataset {class_key: base address} of the preloaded stores (lazy,
     # __new__-safe) for the one-call native episode assembly.
     _class_addr_cache: dict | None = None
+    # __new__-safe default for fixture-driven construction; __init__ derives
+    # the real value from the wire codec (--transfer_dtype uint8).
+    defer_normalization = False
     """Episode synthesizer with deterministic per-index task sampling."""
 
     def __init__(self, args):
@@ -84,6 +87,13 @@ class FewShotLearningDataset:
         self.num_samples_per_class = args.num_samples_per_class
         self.num_classes_per_set = args.num_classes_per_set
         self.augment_images = False
+        # uint8 wire format (--transfer_dtype uint8): normalization moves
+        # onto the device (models/common.WireCodec carries mean/std), so the
+        # host pipeline must keep pixels at k/255 and skip it here.
+        from ..models.common import wire_codec_for
+
+        codec = wire_codec_for(args)
+        self.defer_normalization = codec is not None and codec.mean is not None
 
         # Derived split seeds (data.py:131-142); test seed == val seed.
         val_seed = np.random.RandomState(seed=args.val_seed).randint(1, 999999)
@@ -399,7 +409,7 @@ class FewShotLearningDataset:
                         selected_classes, sample_lists, ks
                     )
                 ])  # (N, K+T, C, H, W)
-            norm = self._fast_normalization()
+            norm = None if self.defer_normalization else self._fast_normalization()
             if norm is not None:
                 mean, std = norm
                 x_images = (x_images - mean) / std
@@ -427,6 +437,7 @@ class FewShotLearningDataset:
                         args=self.args,
                         dataset_name=self.dataset_name,
                         rng=aug_rng,
+                        defer_normalization=self.defer_normalization,
                     )
                     class_image_samples.append(x)
                     class_labels.append(class_to_episode_label[class_entry])

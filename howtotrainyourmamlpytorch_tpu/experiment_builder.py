@@ -142,15 +142,28 @@ class ExperimentBuilder:
         # (measured ~30 s per epoch at 500 iters x 12 metrics).
         host_losses = jax.device_get(total_losses)
         for key in host_losses:
-            values = np.asarray(host_losses[key], dtype=np.float64)
+            # Entries are scalars (K=1) or (K,) per-iteration arrays from
+            # run_train_iters (the epoch-boundary chunk may be shorter):
+            # flatten to one sample per meta-update so mean/std sample
+            # counts are identical at any --iters_per_dispatch.
+            values = np.concatenate(
+                [
+                    np.atleast_1d(np.asarray(v, dtype=np.float64))
+                    for v in host_losses[key]
+                ]
+            )
             summary_losses[f"{phase}_{key}_mean"] = np.mean(values)
             summary_losses[f"{phase}_{key}_std"] = np.std(values)
         return summary_losses
 
     @staticmethod
     def build_loss_summary_string(summary_losses):
+        # Values may be scalars or (K,) per-iteration arrays (K-dispatch
+        # mode); display the latest iteration's value either way.
         return "".join(
-            "{}: {:.4f}, ".format(key, float(value))
+            "{}: {:.4f}, ".format(
+                key, float(np.asarray(jax.device_get(value)).reshape(-1)[-1])
+            )
             for key, value in summary_losses.items()
             if "loss" in key or "accuracy" in key
         )
@@ -244,7 +257,8 @@ class ExperimentBuilder:
 
     def train_iteration_multi(self, samples, epoch_idx, total_losses, current_iter):
         """K iterations in one dispatch (``run_train_iters``); appends the
-        chunk's last-iteration metrics once."""
+        chunk's full ``(K,)`` per-iteration metrics, so epoch summaries have
+        one sample per meta-update at any ``--iters_per_dispatch``."""
         batches = [(s[0], s[1], s[2], s[3]) for s in samples]
         self.train_state, losses = self.model.run_train_iters(
             self.train_state, batches, epoch=epoch_idx

@@ -11,6 +11,7 @@ the LR host-side from the epoch and injecting it into an
 from __future__ import annotations
 
 import math
+from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -52,12 +53,97 @@ def set_injected_lr(opt_state, lr: float):
     return opt_state
 
 
-def prepare_batch(data_batch):
+class WireCodec(NamedTuple):
+    """uint8 host->device image wire format (axon-tunnel leak mitigation +
+    4x less transfer bandwidth).
+
+    The tunnel client leaks every host->device transfer's staging buffer
+    (measured: a bare ``jax.device_put`` loop leaks exactly the bytes
+    transferred; the same loop on a real CPU backend is flat — see
+    ``tools/leak_isolate.py`` and PERF_NOTES.md). Images dominate those
+    bytes, so shipping them as uint8 quarters both the leak rate and the
+    wire bandwidth.
+
+    Encoding is bit-exact by construction for the datasets that opt in:
+    ``wire = rint(x * scale)`` must round-trip, i.e. every host pixel value
+    is ``k / scale`` for integer k in [0, 255]. Omniglot (`scale=1`,
+    pixels exactly 0/1 — mode-'1' PNGs, ``data/dataset.py:245-255``) and
+    the RGB/255 datasets (`scale=255`, pixels k/255) satisfy this; their
+    decoded float32 images are bitwise identical to the float32 wire.
+
+    ``mean``/``std`` (tuples, per channel) move the dataset normalization
+    ONTO the device: the host pipeline must then skip it (the dataset's
+    ``defer_normalization`` flag), so the wire stays in [0, 255].
+    """
+
+    scale: float = 1.0
+    mean: tuple | None = None
+    std: tuple | None = None
+
+
+def encode_images(x: np.ndarray, codec: WireCodec) -> np.ndarray:
+    """float32 host images -> uint8 wire (see WireCodec invariants)."""
+    x = np.asarray(x)
+    if codec.scale != 1.0:
+        x = x * np.float32(codec.scale)
+    return np.rint(x).astype(np.uint8)
+
+
+def decode_images(x, codec: WireCodec | None, dtype):
+    """uint8 wire -> compute-dtype images, inside jit. Op order matches the
+    host pipeline exactly (descale, then normalize) so decoded values are
+    bitwise identical to what the float32 wire would have carried."""
+    if codec is None:
+        return x.astype(dtype)
+    x = x.astype(jnp.float32)
+    if codec.scale != 1.0:
+        x = x / jnp.float32(codec.scale)
+    if codec.mean is not None:
+        mean = jnp.asarray(codec.mean, jnp.float32).reshape(-1, 1, 1)
+        std = jnp.asarray(codec.std, jnp.float32).reshape(-1, 1, 1)
+        x = (x - mean) / std
+    return x.astype(dtype)
+
+
+def wire_codec_for(args) -> WireCodec | None:
+    """The uint8 wire codec for ``args`` (``--transfer_dtype uint8``), or
+    None for datasets whose host pixel values are not 8-bit-representable.
+
+    * omniglot: pixels exactly 0/1 (mode-'1' PNGs) -> scale 1, no norm.
+    * imagenet: pixels k/255, host normalization deferred onto the device.
+    * cifar: crop/flip keep pixels k/255 (zero padding included); the
+      mean/std normalization is deferred onto the device.
+    """
+    if str(getattr(args, "transfer_dtype", "float32")).lower() != "uint8":
+        return None
+    name = args.dataset_name.lower()
+    if "omniglot" in name:
+        return WireCodec(1.0, None, None)
+    if "imagenet" in name:
+        from ..data.augment import IMAGENET_MEAN, IMAGENET_STD
+
+        return WireCodec(
+            255.0, tuple(IMAGENET_MEAN.tolist()), tuple(IMAGENET_STD.tolist())
+        )
+    if "cifar10" in name or "cifar100" in name:
+        return WireCodec(
+            255.0,
+            tuple(float(v) for v in args.classification_mean),
+            tuple(float(v) for v in args.classification_std),
+        )
+    return None
+
+
+def prepare_batch(data_batch, codec: WireCodec | None = None):
     """(B, N, K, C, H, W) numpy episode batch -> flattened device-ready
     arrays, mirroring the reference's ``view(-1, c, h, w)``
-    (``few_shot_learning_system.py:208-213``)."""
+    (``few_shot_learning_system.py:208-213``). With ``codec`` the image
+    arrays go over the wire as uint8 (see WireCodec)."""
     xs, xt, ys, yt = data_batch
-    xs, xt = np.asarray(xs, np.float32), np.asarray(xt, np.float32)
+    if codec is not None:
+        xs, xt = encode_images(xs, codec), encode_images(xt, codec)
+    else:
+        xs, xt = np.asarray(xs, np.float32), np.asarray(xt, np.float32)
     ys, yt = np.asarray(ys, np.int32), np.asarray(yt, np.int32)
     b = xs.shape[0]
     xs = xs.reshape(b, -1, *xs.shape[-3:])

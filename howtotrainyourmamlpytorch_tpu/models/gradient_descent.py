@@ -38,6 +38,7 @@ from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
+    decode_images,
     make_injected_adam,
     prepare_batch,
     set_injected_lr,
@@ -99,6 +100,9 @@ class GradientDescentLearner(CheckpointableLearner):
         """One meta-iteration: sequentially fine-tune over each task."""
         backbone = self.backbone
         xs_b, xt_b, ys_b, yt_b = batch
+        # uint8 wire decode (cast / descale / normalize) — see WireCodec.
+        xs_b = decode_images(xs_b, self.cfg.wire_codec, jnp.float32)
+        xt_b = decode_images(xt_b, self.cfg.wire_codec, jnp.float32)
 
         def task_fn(carry, task):
             theta, bn, opt_state = carry
@@ -146,7 +150,7 @@ class GradientDescentLearner(CheckpointableLearner):
     def run_train_iter(self, state: GDState, data_batch, epoch):
         epoch = int(epoch)
         self.current_epoch = epoch
-        batch = prepare_batch(data_batch)
+        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
         new_state, metrics, _ = self._train_step(state, batch)
@@ -162,7 +166,7 @@ class GradientDescentLearner(CheckpointableLearner):
     def run_validation_iter(self, state: GDState, data_batch):
         """NOTE: mutates state (fine-tunes) by design — returns
         ``(new_state, losses, per_task_preds)``."""
-        batch = prepare_batch(data_batch)
+        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
         new_state, metrics, logits = self._eval_step(state, batch)
         losses = {
             "loss": metrics["loss"],

@@ -47,6 +47,7 @@ from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
+    decode_images,
     make_injected_adam,
     prepare_batch,
     set_injected_lr,
@@ -167,6 +168,9 @@ class MatchingNetsLearner(CheckpointableLearner):
 
     def _run_batch(self, state: MatchingNetsState, batch, *, training: bool):
         xs_b, xt_b, ys_b, yt_b = batch
+        # uint8 wire decode (cast / descale / normalize) — see WireCodec.
+        xs_b = decode_images(xs_b, self.cfg.wire_codec, jnp.float32)
+        xt_b = decode_images(xt_b, self.cfg.wire_codec, jnp.float32)
 
         def task_fn(carry, task):
             theta, bn, opt_state = carry
@@ -202,7 +206,7 @@ class MatchingNetsLearner(CheckpointableLearner):
     def run_train_iter(self, state: MatchingNetsState, data_batch, epoch):
         epoch = int(epoch)
         self.current_epoch = epoch
-        batch = prepare_batch(data_batch)
+        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
         new_state, metrics, _ = self._train_step(state, batch)
@@ -216,7 +220,7 @@ class MatchingNetsLearner(CheckpointableLearner):
         return new_state, losses
 
     def run_validation_iter(self, state: MatchingNetsState, data_batch):
-        batch = prepare_batch(data_batch)
+        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
         _, metrics, preds = self._eval_step(state, batch)
         losses = {
             "loss": metrics["loss"],

@@ -122,6 +122,12 @@ def get_parser() -> argparse.ArgumentParser:
              "'default' (~1%% error, full MXU speed); 'highest'/'float32' "
              "compute true f32 (~3x matmul cost). Second-order MAML at high "
              "way-counts can need 'highest' for stability (PERF_NOTES.md).")
+    add("--transfer_dtype", type=str, default="float32",
+        choices=["float32", "uint8"],
+        help="host->device image wire format. uint8 is bit-exact for "
+             "omniglot/imagenet/cifar (models/common.WireCodec), moves 4x "
+             "fewer bytes through the device tunnel, and quarters the "
+             "tunnel client's per-transfer host-memory leak (PERF_NOTES.md)")
     add("--iters_per_dispatch", type=int, default=1,
         help="K meta-updates per device dispatch (lax.scan iteration batching)")
     add("--data_parallel_devices", type=int, default=0,
@@ -200,6 +206,7 @@ def args_to_maml_config(args):
     """Maps a parsed ``Bunch`` onto the static ``MAMLConfig``/``BackboneConfig``
     pair consumed by the learners (flag semantics per SURVEY §5 C19)."""
     from ..models import BackboneConfig, MAMLConfig
+    from ..models.common import wire_codec_for
 
     # The reference declares --architecture_name but never reads it
     # (utils/parser_utils.py:21 there); here it selects the backbone family.
@@ -278,4 +285,5 @@ def args_to_maml_config(args):
         learnable_bn_gamma=bool(args.learnable_bn_gamma),
         learnable_bn_beta=bool(args.learnable_bn_beta),
         compute_dtype=getattr(args, "compute_dtype", "float32"),
+        wire_codec=wire_codec_for(args),
     )

@@ -1,0 +1,249 @@
+"""uint8 wire-format tests (models/common.WireCodec, --transfer_dtype uint8).
+
+The codec must be BIT-EXACT: decoded device images identical to what the
+float32 wire carries, so golden runs and parity tests hold regardless of the
+wire format. Also covers the deferred-normalization host pipeline the codec
+requires for RGB datasets (axon-tunnel leak mitigation, PERF_NOTES.md).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models.common import (
+    WireCodec,
+    decode_images,
+    encode_images,
+    prepare_batch,
+    wire_codec_for,
+)
+from howtotrainyourmamlpytorch_tpu.data.augment import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    augment_image,
+)
+from howtotrainyourmamlpytorch_tpu.models import (
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+    args_to_maml_config,
+)
+
+from test_data import make_args, make_dataset_dir
+
+
+# ---------------------------------------------------------------------------
+# Codec selection
+# ---------------------------------------------------------------------------
+
+
+def _args(tmp_path, **kw):
+    return make_args(tmp_path, **kw)
+
+
+def test_codec_selection(tmp_path):
+    a = _args(tmp_path, transfer_dtype="uint8", dataset_name="omniglot_dataset")
+    assert wire_codec_for(a) == WireCodec(1.0, None, None)
+
+    a = _args(tmp_path, transfer_dtype="uint8",
+              dataset_name="mini_imagenet_full_size")
+    codec = wire_codec_for(a)
+    assert codec.scale == 255.0
+    np.testing.assert_allclose(codec.mean, IMAGENET_MEAN)
+
+    a = _args(tmp_path, transfer_dtype="uint8", dataset_name="cifar100",
+              classification_mean=[0.5, 0.5, 0.5],
+              classification_std=[0.25, 0.25, 0.25])
+    assert wire_codec_for(a).std == (0.25, 0.25, 0.25)
+
+    # float32 wire or unknown dataset -> no codec
+    assert wire_codec_for(_args(tmp_path, dataset_name="omniglot_dataset")) is None
+    assert wire_codec_for(
+        _args(tmp_path, transfer_dtype="uint8", dataset_name="quickdraw")
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# Bit-exact roundtrip
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_binary_images_exact():
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 2, (4, 1, 28, 28)).astype(np.float32)  # omniglot 0/1
+    codec = WireCodec(1.0, None, None)
+    wire = encode_images(x, codec)
+    assert wire.dtype == np.uint8
+    decoded = np.asarray(decode_images(jnp.asarray(wire), codec, jnp.float32))
+    np.testing.assert_array_equal(decoded, x)
+
+
+def test_roundtrip_rgb255_with_device_norm_exact():
+    """k/255 pixels + deferred normalization == host float32 normalization,
+    bitwise (same f32 op order: /255 then (x-mean)/std)."""
+    rng = np.random.RandomState(1)
+    k = rng.randint(0, 256, (3, 3, 8, 8)).astype(np.float32)
+    host = k / 255.0  # what the deferred host pipeline ships
+    mean = IMAGENET_MEAN.reshape(-1, 1, 1)
+    std = IMAGENET_STD.reshape(-1, 1, 1)
+    host_normalized = (host - mean) / std  # float32-wire reference values
+
+    codec = WireCodec(
+        255.0, tuple(IMAGENET_MEAN.tolist()), tuple(IMAGENET_STD.tolist())
+    )
+    wire = encode_images(host, codec)
+    np.testing.assert_array_equal(wire, k.astype(np.uint8))  # exact k recovery
+    decoded = np.asarray(decode_images(jnp.asarray(wire), codec, jnp.float32))
+    np.testing.assert_array_equal(decoded, host_normalized.astype(np.float32))
+
+
+def test_prepare_batch_uint8_wire():
+    rng = np.random.RandomState(2)
+    xs = rng.randint(0, 2, (2, 5, 1, 1, 4, 4)).astype(np.float32)
+    xt = rng.randint(0, 2, (2, 5, 2, 1, 4, 4)).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1))
+    yt = np.tile(np.arange(5)[None, :, None], (2, 1, 2))
+    codec = WireCodec(1.0, None, None)
+    pxs, pxt, pys, pyt = prepare_batch((xs, xt, ys, yt), codec)
+    assert pxs.dtype == np.uint8 and pxt.dtype == np.uint8
+    assert pxs.shape == (2, 5, 1, 4, 4) and pxt.shape == (2, 10, 1, 4, 4)
+    # Same flattening as the float32 wire
+    fxs, fxt, fys, fyt = prepare_batch((xs, xt, ys, yt))
+    np.testing.assert_array_equal(pxs.astype(np.float32), fxs)
+    np.testing.assert_array_equal(pys, fys)
+
+
+# ---------------------------------------------------------------------------
+# Deferred normalization host pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_augment_defer_normalization_imagenet(tmp_path):
+    args = _args(tmp_path, dataset_name="mini_imagenet_full_size")
+    rng = np.random.RandomState(3)
+    im = rng.randint(0, 256, (8, 8, 3)).astype(np.float32) / 255.0
+    full = augment_image(im.copy(), k=0, channels=3, augment_bool=True,
+                         args=args, dataset_name="mini_imagenet_full_size",
+                         rng=rng)
+    deferred = augment_image(im.copy(), k=0, channels=3, augment_bool=True,
+                             args=args,
+                             dataset_name="mini_imagenet_full_size",
+                             rng=rng, defer_normalization=True)
+    # deferred output is raw k/255 pixels; device normalization reproduces
+    # the host-normalized values exactly
+    mean = IMAGENET_MEAN.reshape(-1, 1, 1)
+    std = IMAGENET_STD.reshape(-1, 1, 1)
+    np.testing.assert_array_equal((deferred - mean) / std, full)
+
+
+def test_augment_defer_normalization_cifar_rng_parity(tmp_path):
+    """Dropping the normalize step must not shift the crop/flip RNG draws."""
+    args = _args(tmp_path, dataset_name="cifar100",
+                 classification_mean=[0.5, 0.5, 0.5],
+                 classification_std=[0.25, 0.25, 0.25])
+    im = np.random.RandomState(4).randint(0, 256, (32, 32, 3)).astype(
+        np.float32
+    ) / 255.0
+    rng_a, rng_b = np.random.RandomState(7), np.random.RandomState(7)
+    full = augment_image(im.copy(), k=0, channels=3, augment_bool=True,
+                         args=args, dataset_name="cifar100", rng=rng_a)
+    deferred = augment_image(im.copy(), k=0, channels=3, augment_bool=True,
+                             args=args, dataset_name="cifar100", rng=rng_b,
+                             defer_normalization=True)
+    np.testing.assert_array_equal(
+        (deferred - np.float32(0.5)) / np.float32(0.25), full
+    )
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: uint8 wire training == float32 wire training, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def omniglot_env(tmp_path, monkeypatch):
+    make_dataset_dir(tmp_path / "omniglot_mini")
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _learner_args(tmp_path, **kw):
+    return make_args(
+        tmp_path,
+        num_stages=2, cnn_num_filters=4, conv_padding=True, max_pooling=True,
+        norm_layer="batch_norm", per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        num_classes_per_set=5, second_order=False,
+        first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True, multi_step_loss_num_epochs=3,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True, learnable_bn_beta=True,
+        meta_learning_rate=0.001, min_learning_rate=1e-5,
+        task_learning_rate=0.1, init_inner_loop_learning_rate=0.1,
+        total_epochs=2, total_iter_per_epoch=2,
+        **kw,
+    )
+
+
+def test_uint8_wire_training_bitwise_identical(omniglot_env):
+    rng = np.random.RandomState(5)
+    xs = rng.randint(0, 2, (2, 5, 1, 1, 12, 12)).astype(np.float32)
+    xt = rng.randint(0, 2, (2, 5, 1, 1, 12, 12)).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1)).astype(np.int32)
+    yt = ys.copy()
+    batch = (xs, xt, ys, yt)
+
+    args_f32 = _learner_args(omniglot_env, image_height=12, image_width=12)
+    args_u8 = _learner_args(omniglot_env, image_height=12, image_width=12,
+                            transfer_dtype="uint8")
+    lf = MAMLFewShotLearner(args_to_maml_config(args_f32))
+    lu = MAMLFewShotLearner(args_to_maml_config(args_u8))
+    assert lu.cfg.wire_codec == WireCodec(1.0, None, None)
+
+    sf = lf.init_state(jax.random.PRNGKey(9))
+    su = lu.init_state(jax.random.PRNGKey(9))
+    for it in range(3):
+        sf, mf = lf.run_train_iter(sf, batch, epoch=0)
+        su, mu = lu.run_train_iter(su, batch, epoch=0)
+        assert float(mf["loss"]) == float(mu["loss"]), f"iter {it}"
+    for a, b in zip(jax.tree.leaves(sf), jax.tree.leaves(su)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # eval path decodes too
+    _, ef, pf = lf.run_validation_iter(sf, batch)
+    _, eu, pu = lu.run_validation_iter(su, batch)
+    assert float(ef["loss"]) == float(eu["loss"])
+    np.testing.assert_array_equal(np.asarray(pf), np.asarray(pu))
+
+
+def test_uint8_wire_gd_and_matching_nets_bitwise_identical(omniglot_env):
+    """The baselines decode the wire too (review finding: with a deferred-
+    normalization codec their steps would otherwise train on raw pixels)."""
+    from howtotrainyourmamlpytorch_tpu.models import (
+        GradientDescentLearner,
+        MatchingNetsLearner,
+    )
+
+    rng = np.random.RandomState(6)
+    xs = rng.randint(0, 2, (2, 5, 1, 1, 12, 12)).astype(np.float32)
+    xt = rng.randint(0, 2, (2, 5, 1, 1, 12, 12)).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1)).astype(np.int32)
+    batch = (xs, xt, ys, ys.copy())
+
+    args_f32 = _learner_args(omniglot_env, image_height=12, image_width=12)
+    args_u8 = _learner_args(omniglot_env, image_height=12, image_width=12,
+                            transfer_dtype="uint8")
+    for cls in (GradientDescentLearner, MatchingNetsLearner):
+        lf = cls(args_to_maml_config(args_f32))
+        lu = cls(args_to_maml_config(args_u8))
+        sf = lf.init_state(jax.random.PRNGKey(13))
+        su = lu.init_state(jax.random.PRNGKey(13))
+        sf, mf = lf.run_train_iter(sf, batch, epoch=0)
+        su, mu = lu.run_train_iter(su, batch, epoch=0)
+        assert float(mf["loss"]) == float(mu["loss"]), cls.__name__
+        _, ef, _ = lf.run_validation_iter(sf, batch)
+        _, eu, _ = lu.run_validation_iter(su, batch)
+        assert float(ef["loss"]) == float(eu["loss"]), cls.__name__

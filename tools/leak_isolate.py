@@ -1,0 +1,110 @@
+"""Isolates the per-iteration RSS leak: JAX dispatch vs data pipeline.
+
+Scenarios (pick with argv[1], default 'all'):
+  jit_keep    - jit step with fresh 5MB numpy input each iter, KEEP output
+                scalars in a list, clear every 50 iters (mimics the builder)
+  jit_nokeep  - same but outputs read immediately (float()) and dropped
+  data_only   - synthesize + collate episodes, never touch JAX
+  jit_const   - jit step with the SAME input array each iter (no transfers)
+
+Each runs 300 iterations printing RSS every 50.
+Usage: JAX_PLATFORMS=cpu python tools/leak_isolate.py [scenario]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return -1.0
+
+
+def run_jit(keep: bool, fresh_input: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def step(w, batch):
+        loss = jnp.mean((batch @ w) ** 2)
+        acc = jnp.mean(batch)
+        return w - 1e-4 * loss, {"loss": loss, "accuracy": acc}
+
+    w = jnp.zeros((784, 16))
+    rng = np.random.RandomState(0)
+    base = rng.rand(1600, 784).astype(np.float32)  # ~5 MB
+    kept: list = []
+    for i in range(300):
+        batch = (base + np.float32(i)) if fresh_input else base
+        w, metrics = step(w, batch)
+        if keep:
+            kept.append(metrics)
+            if len(kept) >= 50:
+                kept.clear()
+        else:
+            float(metrics["loss"])
+        if (i + 1) % 50 == 0:
+            jax.block_until_ready(w)
+            print(f"  iter {i+1:4d}  rss {rss_mb():9.1f} MB", flush=True)
+
+
+def run_data_only() -> None:
+    import pathlib
+    import tempfile
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests",
+        ),
+    )
+    from test_data import make_args, make_dataset_dir
+
+    from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+
+    tmp = tempfile.mkdtemp(prefix="leak_iso_")
+    tmp_path = pathlib.Path(tmp)
+    make_dataset_dir(tmp_path / "omniglot_mini", n_alphabets=10, n_chars=8,
+                     n_imgs=11)
+    os.environ["DATASET_DIR"] = str(tmp_path)
+    args = make_args(
+        tmp_path, batch_size=8, num_classes_per_set=20,
+        num_samples_per_class=5, num_target_samples=5,
+        num_dataprovider_workers=2,
+    )
+    loader = MetaLearningSystemDataLoader(args=args, current_iter=0)
+    n = 0
+    for _ in range(6):
+        for batch in loader.get_train_batches(total_batches=50,
+                                              augment_images=True):
+            n += 1
+            if n % 50 == 0:
+                print(f"  iter {n:4d}  rss {rss_mb():9.1f} MB", flush=True)
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    scenarios = {
+        "jit_keep": lambda: run_jit(keep=True, fresh_input=True),
+        "jit_nokeep": lambda: run_jit(keep=False, fresh_input=True),
+        "jit_const": lambda: run_jit(keep=True, fresh_input=False),
+        "data_only": run_data_only,
+    }
+    for name, fn in scenarios.items():
+        if which not in ("all", name):
+            continue
+        print(f"== {name} ==", flush=True)
+        fn()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Host-memory leak probe for the experiment runtime (VERDICT r2 weak #2).
+
+Reproduces the long-run training path on CPU with 20-way-shaped episode
+batches (batch 8, 20 classes, 5 shots — the flagship 20w-5s host-side data
+load) but a tiny first-order model, and logs per-epoch:
+
+  * RSS (VmRSS from /proc/self/status)
+  * number of live JAX arrays (jax.live_arrays()) — leaked device buffers
+  * total Python objects (gc.get_objects()) — leaked host structures
+
+Usage:  python tools/leak_probe.py [--epochs 15] [--iters 50]
+                                   [--platform cpu|default]
+
+NOTE: ``JAX_PLATFORMS=cpu`` is NOT honored in this image — the axon
+sitecustomize registers the tunnel backend and pins the platform config, so
+the env var silently leaves you on the TPU tunnel. ``--platform cpu``
+(default) goes through ``utils.platform.force_virtual_cpu``, which works;
+``--platform default`` keeps the tunnel device to measure ITS leak.
+Prints one line per epoch and a final verdict: the regression criterion is
+RSS slope over the last half of the run (first epochs are excluded — jit
+compilation and cache warmup legitimately allocate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    ),
+)
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return -1.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=15)
+    parser.add_argument("--iters", type=int, default=50)
+    parser.add_argument("--ways", type=int, default=20)
+    parser.add_argument("--shots", type=int, default=5)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--backend", default="thread")
+    parser.add_argument("--platform", default="cpu",
+                        choices=["cpu", "default"])
+    args_cli = parser.parse_args()
+
+    if args_cli.platform == "cpu":
+        from howtotrainyourmamlpytorch_tpu.utils.platform import (
+            force_virtual_cpu,
+        )
+
+        force_virtual_cpu(1)
+
+    import jax
+    import numpy as np
+
+    from test_data import make_args, make_dataset_dir  # noqa: E402
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        ExperimentBuilder,
+    )
+    from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+        args_to_maml_config,
+    )
+
+    import pathlib
+
+    tmp = tempfile.mkdtemp(prefix="leak_probe_")
+    tmp_path = pathlib.Path(tmp)
+    # Enough classes for a 20-way split (80 classes -> 40 train / 20 / 20)
+    # and shots+targets images per class.
+    make_dataset_dir(
+        tmp_path / "omniglot_mini",
+        n_alphabets=10,
+        n_chars=8,
+        n_imgs=2 * args_cli.shots + 1,
+    )
+    os.environ["DATASET_DIR"] = str(tmp_path)
+
+    args = make_args(
+        tmp_path,
+        experiment_name=os.path.join(tmp, "exp"),
+        seed=11,
+        continue_from_epoch="from_scratch",
+        max_models_to_save=5,
+        total_epochs=args_cli.epochs,
+        total_iter_per_epoch=args_cli.iters,
+        total_epochs_before_pause=args_cli.epochs + 1,
+        num_evaluation_tasks=2 * args_cli.batch,
+        evaluate_on_test_set_only=False,
+        batch_size=args_cli.batch,
+        num_classes_per_set=args_cli.ways,
+        num_samples_per_class=args_cli.shots,
+        num_target_samples=args_cli.shots,
+        num_dataprovider_workers=2,
+        dataprovider_backend=args_cli.backend,
+        # tiny first-order model: the leak is host-side, keep compute cheap
+        num_stages=2,
+        cnn_num_filters=4,
+        conv_padding=True,
+        max_pooling=True,
+        norm_layer="batch_norm",
+        per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False,
+        first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=3,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True,
+        learnable_bn_beta=True,
+        meta_learning_rate=0.001,
+        min_learning_rate=1e-5,
+        task_learning_rate=0.1,
+        init_inner_loop_learning_rate=0.1,
+    )
+
+    model = MAMLFewShotLearner(args_to_maml_config(args))
+    builder = ExperimentBuilder(
+        args=args, data=MetaLearningSystemDataLoader, model=model, device=None
+    )
+
+    samples: list[tuple[int, float, int, int]] = []
+
+    orig_save = builder.save_models
+
+    def probed_save(model, epoch, state):  # noqa: ANN001
+        orig_save(model=model, epoch=epoch, state=state)
+        gc.collect()
+        n_live = len(jax.live_arrays())
+        n_obj = len(gc.get_objects())
+        mb = rss_mb()
+        samples.append((int(epoch), mb, n_live, n_obj))
+        print(
+            f"[leak_probe] epoch {int(epoch):3d}  rss {mb:9.1f} MB  "
+            f"jax_arrays {n_live:6d}  py_objects {n_obj:8d}",
+            flush=True,
+        )
+
+    builder.save_models = probed_save
+    builder.run_experiment()
+
+    # Verdict: slope over the last half (warmup excluded).
+    half = samples[len(samples) // 2 :]
+    if len(half) < 2:
+        print("[leak_probe] not enough samples")
+        return 2
+    epochs = np.array([s[0] for s in half], dtype=np.float64)
+    rss = np.array([s[1] for s in half], dtype=np.float64)
+    arrays = np.array([s[2] for s in half], dtype=np.float64)
+    slope = np.polyfit(epochs, rss, 1)[0]
+    arr_slope = np.polyfit(epochs, arrays, 1)[0]
+    print(
+        f"[leak_probe] steady-state RSS slope: {slope:+.2f} MB/epoch; "
+        f"jax-array slope: {arr_slope:+.1f}/epoch "
+        f"({samples[0][1]:.0f} -> {samples[-1][1]:.0f} MB over "
+        f"{len(samples)} epochs)"
+    )
+    leak = slope > 5.0 or arr_slope > 10.0
+    print("[leak_probe] LEAK" if leak else "[leak_probe] FLAT")
+    return 1 if leak else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""tracemalloc-based drill-down for the per-iteration host leak.
+
+Runs the same harness as leak_probe.py but snapshots tracemalloc between
+epochs and prints the top allocation-site diffs. numpy>=1.13 registers array
+buffers with tracemalloc, so leaked batch arrays show their allocation site.
+
+Usage: JAX_PLATFORMS=cpu python tools/leak_tracemalloc.py
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import tempfile
+import tracemalloc
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    ),
+)
+
+
+def main() -> None:
+    import pathlib
+
+    from test_data import make_args, make_dataset_dir  # noqa: E402
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        ExperimentBuilder,
+    )
+    from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+        args_to_maml_config,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="leak_tm_")
+    tmp_path = pathlib.Path(tmp)
+    make_dataset_dir(tmp_path / "omniglot_mini", n_alphabets=10, n_chars=8,
+                     n_imgs=11)
+    os.environ["DATASET_DIR"] = str(tmp_path)
+
+    args = make_args(
+        tmp_path,
+        experiment_name=os.path.join(tmp, "exp"),
+        seed=11, continue_from_epoch="from_scratch", max_models_to_save=5,
+        total_epochs=4, total_iter_per_epoch=15,
+        total_epochs_before_pause=99, num_evaluation_tasks=8,
+        evaluate_on_test_set_only=False, batch_size=8,
+        num_classes_per_set=20, num_samples_per_class=5,
+        num_target_samples=5, num_dataprovider_workers=2,
+        num_stages=2, cnn_num_filters=4, conv_padding=True, max_pooling=True,
+        norm_layer="batch_norm", per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False, first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True, multi_step_loss_num_epochs=3,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True, learnable_bn_beta=True,
+        meta_learning_rate=0.001, min_learning_rate=1e-5,
+        task_learning_rate=0.1, init_inner_loop_learning_rate=0.1,
+    )
+
+    model = MAMLFewShotLearner(args_to_maml_config(args))
+    builder = ExperimentBuilder(
+        args=args, data=MetaLearningSystemDataLoader, model=model, device=None
+    )
+
+    tracemalloc.start(10)
+    snaps = []
+    orig_save = builder.save_models
+
+    def probed_save(model, epoch, state):  # noqa: ANN001
+        orig_save(model=model, epoch=epoch, state=state)
+        gc.collect()
+        snaps.append(tracemalloc.take_snapshot())
+        if len(snaps) >= 2:
+            diff = snaps[-1].compare_to(snaps[-2], "traceback")
+            print(f"===== epoch {int(epoch)} top growth =====", flush=True)
+            for stat in diff[:6]:
+                if stat.size_diff <= 0:
+                    continue
+                print(f"  +{stat.size_diff/1e6:8.2f} MB  count+{stat.count_diff}")
+                for line in stat.traceback.format()[-6:]:
+                    print("   ", line)
+
+    builder.save_models = probed_save
+    builder.run_experiment()
+
+
+if __name__ == "__main__":
+    main()
