@@ -5,4 +5,4 @@ export DEVICE_ID=$1
 echo $DEVICE_ID
 cd ..
 export DATASET_DIR="datasets/"
-python train_gradient_descent_system.py --name_of_args_json_file experiment_config/omniglot_gradient-descent-omniglot_1_8_0.1_64_5_1.json --gpu_to_use $DEVICE_ID
+python train_gradient_descent_system.py --name_of_args_json_file experiment_config/omniglot_gradient-descent-omniglot_1_8_0.1_64_5_1.json --gpu_to_use $DEVICE_ID --transfer_dtype uint8

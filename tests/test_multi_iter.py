@@ -97,7 +97,7 @@ def test_multi_iter_sharded_mesh():
 
 def test_k_dispatch_summary_sample_fidelity(tmp_path, monkeypatch):
     """Epoch CSV mean/std must be computed from one sample per meta-update
-    at any --iters_per_dispatch (VERDICT r2 weak #6): a K=5 run over the
+    at any --iters_per_dispatch (VERDICT r2 weak #6): a K=4 run over the
     same deterministic stream produces the same per-epoch summary
     statistics as K=1 (tolerance-equal; the scanned program compiles
     differently)."""

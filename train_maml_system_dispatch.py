@@ -34,6 +34,8 @@ def main() -> int:
         i = extra.index("--pause_every")
         pause_every = int(extra[i + 1])
         extra = extra[:i] + extra[i + 2 :]
+        if pause_every < 1:
+            raise SystemExit(f"--pause_every must be >= 1, got {pause_every}")
 
     entry = ("train_gradient_descent_system.py" if "gradient-descent" in cfg
              else "train_matching_nets_system.py" if "matching-nets" in cfg
@@ -70,6 +72,7 @@ def main() -> int:
               "nothing to run", flush=True)
         return 0
 
+    patched_path = None
     if pause_every is not None:
         # A --total_epochs_before_pause CLI flag would be OVERRIDDEN by the
         # config JSON (JSON wins over every flag except continue_from/
@@ -84,39 +87,46 @@ def main() -> int:
         )
         json.dump(cfg_dict, patched)
         patched.close()
-        cfg_path = patched.name
+        cfg_path = patched_path = patched.name
 
-    max_phases = 2 * (total_epochs // (pause_every or total_epochs) + 2)
-    stalled = 0
-    rc = 0
-    for phase in range(max_phases):
-        before = epochs_logged()
-        print(f"--- {cfg}: phase {phase} via {entry} "
-              f"(epochs logged: {before}/{total_epochs})", flush=True)
-        proc = subprocess.run(
-            [sys.executable, "-u", entry, "--name_of_args_json_file",
-             cfg_path, *extra], check=False,
-        )
-        rc = proc.returncode
-        if os.path.exists(test_csv):
-            break
-        if epochs_logged() <= before:
-            stalled += 1
-            if stalled >= 2:
-                print(f"--- {cfg}: no progress across two phases, aborting",
-                      flush=True)
-                return rc or 1
-        else:
-            stalled = 0
-    if not os.path.exists(test_csv):
-        print(f"--- {cfg}: phase budget exhausted without test eval",
-              flush=True)
-        return rc or 1
-    print(f"--- {cfg}: done ({epochs_logged()} epochs + test eval, "
-          f"final phase rc {rc})", flush=True)
-    # Exit-code fidelity: the phase that produced the test eval still
-    # decides the exit code (a teardown failure must not be masked).
-    return rc
+    try:
+        max_phases = 2 * (total_epochs // (pause_every or total_epochs) + 2)
+        stalled = 0
+        rc = 0
+        for phase in range(max_phases):
+            before = epochs_logged()
+            print(f"--- {cfg}: phase {phase} via {entry} "
+                  f"(epochs logged: {before}/{total_epochs})", flush=True)
+            proc = subprocess.run(
+                [sys.executable, "-u", entry, "--name_of_args_json_file",
+                 cfg_path, *extra], check=False,
+            )
+            rc = proc.returncode
+            if os.path.exists(test_csv):
+                break
+            if epochs_logged() <= before:
+                stalled += 1
+                if stalled >= 2:
+                    print(f"--- {cfg}: no progress across two phases, "
+                          "aborting", flush=True)
+                    return rc or 1
+            else:
+                stalled = 0
+        if not os.path.exists(test_csv):
+            print(f"--- {cfg}: phase budget exhausted without test eval",
+                  flush=True)
+            return rc or 1
+        print(f"--- {cfg}: done ({epochs_logged()} epochs + test eval, "
+              f"final phase rc {rc})", flush=True)
+        # Exit-code fidelity: the phase that produced the test eval still
+        # decides the exit code (a teardown failure must not be masked).
+        return rc
+    finally:
+        if patched_path is not None:
+            try:
+                os.unlink(patched_path)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":
