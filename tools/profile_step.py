@@ -1,0 +1,102 @@
+"""Steady-state step analysis for the flagship MAML++ program (VERDICT r2
+weak #3 / next #4): quantitative dispatch/transfer/compute breakdown plus an
+optional jax.profiler trace capture.
+
+Usage: python tools/profile_step.py [--trace profiles/flagship]
+
+Prints (quiet chip, shipped u8 wire):
+  * compiled-program cost analysis: FLOPs/iter, HBM bytes/iter
+  * measured per-iter wall time at K=25 scan dispatch
+  * roofline bounds: MXU-bound time (flops/peak), HBM-bound time
+    (bytes/bandwidth) -> which resource the step is actually limited by
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+V5E_PEAK_BF16_FLOPS = 394e12
+V5E_PEAK_F32MULT_FLOPS = 197.4e12  # bench.py's MFU denominator
+V5E_HBM_BYTES_PER_S = 819e9
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--trace", default="")
+    parser.add_argument("--k", type=int, default=25)
+    args = parser.parse_args()
+
+    import dataclasses
+
+    from __graft_entry__ import _episode_batch, _flagship_config
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+    from howtotrainyourmamlpytorch_tpu.models.common import WireCodec
+
+    cfg = dataclasses.replace(
+        _flagship_config(), wire_codec=WireCodec(1.0, None, None)
+    )
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    K = args.k
+    batches = [_episode_batch(8, cfg, rng) for _ in range(K)]
+    epoch = 20  # steady-state variant: second order, past the MSL horizon
+
+    lowered = learner.lowered_train_iters(state, batches, epoch)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    flops_iter = float(cost.get("flops", 0.0)) / K
+    bytes_iter = float(cost.get("bytes accessed", 0.0)) / K
+    print(f"flops/iter          : {flops_iter:.3e}")
+    print(f"hbm bytes/iter      : {bytes_iter:.3e}")
+
+    # Wire bytes per iter (uint8 images + int32 labels).
+    xs, xt, ys, yt = learner._prepare_batch(batches[0])
+    wire = sum(a.nbytes for a in (xs, xt, ys, yt))
+    print(f"wire bytes/iter     : {wire:.3e} (u8) "
+          f"/ {4 * (xs.size + xt.size) + ys.nbytes + yt.nbytes:.3e} (f32)")
+
+    # Measured steady-state rate.
+    state, _ = learner.run_train_iters(state, batches, epoch=epoch)
+    jax.block_until_ready(state.theta)
+    t0 = time.perf_counter()
+    reps = 40
+    for _ in range(reps):
+        state, _ = learner.run_train_iters(state, batches, epoch=epoch)
+    jax.block_until_ready(state.theta)
+    dt = time.perf_counter() - t0
+    per_iter = dt / (reps * K)
+    print(f"measured wall/iter  : {per_iter*1e6:.1f} us "
+          f"({reps*K/dt:.0f} meta-iters/s)")
+
+    mxu = flops_iter / V5E_PEAK_F32MULT_FLOPS
+    hbm = bytes_iter / V5E_HBM_BYTES_PER_S
+    print(f"mxu-bound time/iter : {mxu*1e6:.1f} us "
+          f"({100*mxu/per_iter:.1f}% of measured)")
+    print(f"hbm-bound time/iter : {hbm*1e6:.1f} us "
+          f"({100*hbm/per_iter:.1f}% of measured)")
+    slack = per_iter - max(mxu, hbm)
+    print(f"latency slack/iter  : {slack*1e6:.1f} us "
+          "(neither-MXU-nor-HBM: kernel launch/serialization overhead)")
+
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+        for _ in range(3):
+            state, _ = learner.run_train_iters(state, batches, epoch=epoch)
+        jax.block_until_ready(state.theta)
+        jax.profiler.stop_trace()
+        print(f"trace written to {args.trace}")
+
+
+if __name__ == "__main__":
+    main()
