@@ -86,17 +86,27 @@ class WireCodec(NamedTuple):
 
 
 def encode_images(x: np.ndarray, codec: WireCodec) -> np.ndarray:
-    """float32 host images -> uint8 wire (see WireCodec invariants)."""
+    """float32 host images -> uint8 wire (see WireCodec invariants).
+
+    Values are clipped to [0, 255] before the cast: ``astype(uint8)`` would
+    silently WRAP out-of-range values (-1.0 -> 255, 256 -> 0), so a dataset
+    or transform that violates the k/scale invariant would corrupt images
+    without an error. Clipping bounds the damage; exactness for in-range
+    values is unchanged (rint of in-range k stays k).
+    """
     x = np.asarray(x)
     if codec.scale != 1.0:
         x = x * np.float32(codec.scale)
-    return np.rint(x).astype(np.uint8)
+    return np.clip(np.rint(x), 0.0, 255.0).astype(np.uint8)
 
 
 def decode_images(x, codec: WireCodec | None, dtype):
     """uint8 wire -> compute-dtype images, inside jit. Op order matches the
-    host pipeline exactly (descale, then normalize) so decoded values are
-    bitwise identical to what the float32 wire would have carried."""
+    host pipeline (descale, then normalize). For the scale-only case
+    (omniglot) decoded values are bitwise identical to what the float32 wire
+    would have carried; for mean/std codecs XLA may reassociate the ``/std``
+    inside the fused step, so RGB datasets match to ~1 ulp (see the WireCodec
+    docstring and tests/test_imagenet_path.py)."""
     if codec is None:
         return x.astype(dtype)
     x = x.astype(jnp.float32)

@@ -139,6 +139,12 @@ def get_parser() -> argparse.ArgumentParser:
     add("--resnet_widths", nargs="+", type=int, default=None,
         help="4 stage widths for architecture_name=resnet12 (default "
              "cnn_num_filters x 1/2/4/8; MetaOptNet uses 64 160 320 640)")
+    add("--parity_bug", type=str, default="False",
+        help="matching-nets only: True reproduces the reference's "
+             "last-task-only loss/accuracy reporting bug bit-for-bit "
+             "(reference matching_networks.py loss loop; see "
+             "models/matching_nets.py and GOLDEN_RUNS.md); False (default) "
+             "trains on the mean over all tasks in the batch")
     return parser
 
 

@@ -12,6 +12,26 @@ from __future__ import annotations
 import os
 
 
+def force_virtual_cpu_env(n_devices: int) -> None:
+    """The platform-retarget half of :func:`force_virtual_cpu`, WITHOUT the
+    device probe. ``jax.distributed.initialize`` must run before anything
+    initializes the XLA backend (``jax.devices`` does), so multi-process
+    tests call this first, then initialize distributed, then probe."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; nothing more this can do
+
+
 def force_virtual_cpu(n_devices: int) -> list:
     """Force the CPU platform with ``n_devices`` virtual devices.
 
@@ -26,19 +46,9 @@ def force_virtual_cpu(n_devices: int) -> list:
     the default platform offers (matching the pre-round-2 behavior) and the
     caller's device-count assertion reports the shortfall.
     """
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    force_virtual_cpu_env(n_devices)
 
     import jax
-
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except RuntimeError:
-        pass  # backend already initialized; fall through to whatever exists
 
     devices = jax.devices("cpu")
     if len(devices) < n_devices:

@@ -27,6 +27,35 @@ def test_windowed_rates_even_count_is_true_median():
     assert median == 7.5  # (10 + 5) / 2
 
 
+def test_quiet_sentinel_norm_env_override(monkeypatch):
+    monkeypatch.setenv("BENCH_QUIET_SENTINEL_MS", "0.25")
+    assert bench._quiet_sentinel_norm_ms("TPU v5 lite") == 0.25
+
+
+def test_quiet_sentinel_norm_by_kind(monkeypatch):
+    monkeypatch.delenv("BENCH_QUIET_SENTINEL_MS", raising=False)
+    assert bench._quiet_sentinel_norm_ms("TPU v5 lite0") == 0.04
+    assert bench._quiet_sentinel_norm_ms("cpu") == 0.02
+    # unknown backend falls back to the v5e-class norm rather than crashing
+    assert bench._quiet_sentinel_norm_ms("TPU v99") == 0.04
+
+
+def test_live_trainer_pids_sees_trainer_cmdline(tmp_path):
+    """A live train_*_system process must be detected (the r3 contamination
+    was a trainer that was host-side when the device sentinel ran)."""
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "train_maml_system_fake.py"
+    script.write_text("import time; time.sleep(30)\n")
+    proc = subprocess.Popen([_sys.executable, str(script)])
+    try:
+        assert proc.pid in bench._live_trainer_pids()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def test_time_boxed_window_counts_units_and_drains():
     drained = []
     ticks = iter(x * 0.25 for x in range(100))

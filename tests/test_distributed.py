@@ -1,0 +1,121 @@
+"""Two-process ``jax.distributed`` bring-up (VERDICT r3 next #7).
+
+Real multi-host hardware is unavailable here, but the multi-host wiring in
+``parallel/distributed.py`` is still testable: two forked CPU processes —
+one coordinator, one worker — each with 2 virtual devices, must come up as
+ONE global runtime of 2 processes x 2 local devices = 4 global devices.
+This is the first executed evidence that ``initialize_distributed`` passes
+the right arguments through to ``jax.distributed.initialize`` and that the
+opt-in env-var path composes with the platform forcing.
+
+The reference has no multi-node backend at all (no ``torch.distributed``
+anywhere — SURVEY §2); this subsystem is a TPU-framework extension.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# argv[1] = coordinator address, argv[2] = process id, argv[3] = mode
+# (args | env). Asserts the global runtime spans both processes.
+WORKER_SRC = textwrap.dedent(
+    """
+    import os, sys
+
+    # Platform retarget WITHOUT a device probe: jax.distributed.initialize
+    # must run before anything initializes the XLA backend.
+    from howtotrainyourmamlpytorch_tpu.utils.platform import force_virtual_cpu_env
+
+    force_virtual_cpu_env(2)
+
+    from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+
+    addr, pid, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    if mode == "env":
+        os.environ["JAX_COORDINATOR_ADDRESS"] = addr
+        os.environ["JAX_NUM_PROCESSES"] = "2"
+        initialize_distributed(process_id=pid)
+    else:
+        initialize_distributed(
+            coordinator_address=addr, num_processes=2, process_id=pid
+        )
+
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 2, jax.local_device_count()
+    assert jax.device_count() == 4, jax.device_count()
+    print("DISTRIBUTED_OK", pid, jax.device_count())
+    """
+)
+
+
+def _free_port() -> int:
+    """A free localhost port — or a skip if this sandbox has no sockets.
+
+    The bind here doubles as the capability probe: once it succeeds,
+    loopback networking provably works, so a later bring-up hang is a REAL
+    failure (deadlocked initialize), not an environment artifact."""
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+    except OSError as e:
+        pytest.skip(f"loopback sockets unavailable in this sandbox: {e}")
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # The workers must opt in via their own explicit signal only.
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.pop("JAX_NUM_PROCESSES", None)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count (2, not 8)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.parametrize("mode", ["args", "env"])
+def test_two_process_cpu_bringup(tmp_path, mode):
+    addr = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "distributed_worker.py"
+    script.write_text(WORKER_SRC)
+    env = _clean_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), addr, str(pid), mode],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=REPO,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        partial = []
+        for p in procs:
+            p.kill()
+            out, _ = p.communicate()
+            partial.append(out)
+        # Loopback provably works (_free_port bound a socket), so a hang IS
+        # the failure class this test exists to catch — a deadlocked
+        # bring-up must not report as a green skip.
+        pytest.fail(
+            "distributed bring-up deadlocked (120 s):\n"
+            + "\n---\n".join(partial)
+        )
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    for pid, out in enumerate(outs):
+        assert f"DISTRIBUTED_OK {pid} 4" in out, out

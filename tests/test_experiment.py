@@ -56,6 +56,17 @@ def test_get_args_json_override(tmp_path, monkeypatch):
     assert args.dataset_path == os.path.join(str(tmp_path), "omniglot_dataset")
 
 
+def test_parity_bug_flag_parses_and_coerces(tmp_path, monkeypatch):
+    """`--parity_bug` is a real CLI flag (GOLDEN_RUNS.md documents it as the
+    reproduction knob for the reference matching-nets reporting bug) and goes
+    through the string->bool coercion like every other reference-style flag."""
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    args, _ = get_args(["--parity_bug", "True"])
+    assert args.parity_bug is True
+    args, _ = get_args([])
+    assert args.parity_bug is False
+
+
 def test_args_to_maml_config(tmp_path, monkeypatch):
     monkeypatch.setenv("DATASET_DIR", str(tmp_path))
     cfg = {

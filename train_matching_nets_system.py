@@ -14,7 +14,10 @@ from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
 if __name__ == "__main__":
     initialize_distributed()  # no-op without explicit multi-host env signal
     args, device = get_args()
-    model = MatchingNetsLearner(cfg=args_to_maml_config(args))
+    model = MatchingNetsLearner(
+        cfg=args_to_maml_config(args),
+        parity_bug=bool(getattr(args, "parity_bug", False)),
+    )
     maybe_unzip_dataset(args)
     system = ExperimentBuilder(
         model=model, data=MetaLearningSystemDataLoader, args=args, device=device
