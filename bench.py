@@ -187,7 +187,8 @@ def _time_boxed_window(budget_s, step, drain, clock=time.perf_counter):
     return run_window
 
 
-def _measure(cfg, repeats=100, K=DISPATCH_CHUNK, windows=5):
+def _measure(cfg, repeats=100, K=DISPATCH_CHUNK, windows=5,
+             batch_size=8, shots=1, targets_per_class=None):
     """``repeats`` is the MINIMUM number of K-iteration dispatches measured;
     it is rounded UP to fill ``windows`` equal windows. Windows must be long
     (hundreds of ms) relative to the one drain round-trip each pays, or the
@@ -197,7 +198,10 @@ def _measure(cfg, repeats=100, K=DISPATCH_CHUNK, windows=5):
     learner = MAMLFewShotLearner(cfg)
     state = learner.init_state(jax.random.PRNGKey(0))
     rng2 = np.random.RandomState(1)
-    batches = [_episode_batch(8, cfg, rng2) for _ in range(K)]
+    batches = [
+        _episode_batch(batch_size, cfg, rng2, shots, targets_per_class)
+        for _ in range(K)
+    ]
     # Steady-state regime of the flagship run: second order, past the MSL
     # horizon (90 of 100 epochs) — epoch 20 selects that compiled variant.
     epoch = 20
@@ -219,11 +223,19 @@ def _measure(cfg, repeats=100, K=DISPATCH_CHUNK, windows=5):
     return median, peak, mean, learner, batches, epoch, K
 
 
-def _flops_per_iter(learner, state_template, batches, epoch, K):
+def _flops_per_iter(learner, state_template, batches, epoch):
     """FLOPs of one meta-iteration from the compiled program's own cost
     analysis (falls back to None off-TPU or if the backend omits flops).
-    ``lowered_train_iters`` lowers the SAME program variant the measurement
-    ran, so the MFU numerator matches."""
+
+    XLA's cost analysis counts a ``lax.scan``/while-loop BODY ONCE, not
+    times the trip count (verified on this backend: the reported flops of
+    the K-iteration scan program are identical for K=1/5/25, and agree
+    with a rough hand count of one meta-iteration to ~13% — inside the
+    hand count's own approximation error; PERF_NOTES.md "Corrected MFU
+    accounting"). The body cost therefore IS the per-iteration cost — do
+    NOT divide by the dispatch chunk K. Rounds 1-3 divided, understating
+    every reported MFU by 25x (1.68% reported vs ~45% true for the r3
+    flagship)."""
     try:
         cost = (
             learner.lowered_train_iters(state_template, batches, epoch)
@@ -233,7 +245,7 @@ def _flops_per_iter(learner, state_template, batches, epoch, K):
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
-        return flops / K if flops > 0 else None
+        return flops if flops > 0 else None
     except Exception as exc:  # noqa: BLE001 — observability only
         print(f"# cost analysis unavailable: {exc}", file=sys.stderr)
         return None
@@ -358,10 +370,13 @@ def _measure_k1(learner, batches, epoch, seconds: float = 6.0):
 
 
 def _imagenet_shape_config():
-    """Mini-ImageNet flagship shapes (84x84x3, 48 filters, stride-2 convs,
-    batch 2, grad clamp +-10 — experiment_config/mini-imagenet_maml++-
-    mini-imagenet_5_2_0.01_48_5_0.json) for the device-throughput variant;
-    the dataset itself is absent from this environment (VERDICT r2
+    """Mini-ImageNet north-star shapes (84x84x3, 48 filters, MAX-POOLING
+    blocks, batch 2, grad clamp +-10 — experiment_config/mini-imagenet_
+    maml++-mini-imagenet_5_2_0.01_48_5_0.json sets ``max_pooling: true``;
+    the r2/r3 bench variant measured a strided-conv network that no shipped
+    imagenet config trains). Pair with ``_measure(..., batch_size=2,
+    shots=5, targets_per_class=15)`` for the config's real episode shape.
+    The dataset itself is absent from this environment (VERDICT r2
     missing #1)."""
     import dataclasses
 
@@ -376,7 +391,7 @@ def _imagenet_shape_config():
             image_channels=3,
             image_height=84,
             image_width=84,
-            max_pooling=False,  # strided convs + global avg-pool
+            max_pooling=True,  # the real config: conv stride 1 + 2x2 maxpool
         ),
         task_learning_rate=0.01,
         clip_grad_value=10.0,
@@ -408,7 +423,7 @@ def main() -> None:
         PEAK_FLOPS_BY_KIND["TPU v5 lite"],
     )
     state_template = learner.init_state(jax.random.PRNGKey(0))
-    flops = _flops_per_iter(learner, state_template, batches, epoch, K)
+    flops = _flops_per_iter(learner, state_template, batches, epoch)
     if flops:
         mfu = value * flops / chip_peak_flops
 
@@ -427,17 +442,16 @@ def main() -> None:
     f32_value, *_rest = _measure(f32_cfg, repeats=50)
 
     # Mini-ImageNet shapes (dataset absent here; device throughput + MFU at
-    # the real 84x84x3/48-filter/strided/batch-2 configuration).
+    # the real 84x84x3/48-filter/max-pool/5-shot/15-target/batch-2 config).
     imagenet_cfg = _imagenet_shape_config()
-    (im_value, _imp, _ims, im_learner, im_batches, im_epoch, im_K) = _measure(
-        imagenet_cfg, repeats=30
+    (im_value, _imp, _ims, im_learner, im_batches, im_epoch, _im_K) = _measure(
+        imagenet_cfg, repeats=30, batch_size=2, shots=5, targets_per_class=15
     )
     im_flops = _flops_per_iter(
         im_learner,
         im_learner.init_state(jax.random.PRNGKey(0)),
         im_batches,
         im_epoch,
-        im_K,
     )
 
     real = _measure_real_data()
@@ -496,7 +510,7 @@ def main() -> None:
                 "dispatch_overhead_ms": round(
                     1e3 * (1.0 / k1_rate - 1.0 / value), 3
                 ),
-                # Mini-ImageNet flagship shapes (84x84x3, 48f, strided,
+                # Mini-ImageNet north-star shapes (84x84x3, 48f, max-pool,
                 # batch 2; dataset absent in this environment).
                 "imagenet_shape_meta_iters_per_s": round(im_value, 2),
                 "imagenet_shape_mfu": (

@@ -11,6 +11,26 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Layout experiment switch (VERDICT r3 next #2). The framework's tensor
+# contract is NCHW/OIHW (the reference's torch layout, pinned by the parity
+# tests); "NHWC" keeps that external contract but runs the conv itself in
+# NHWC/HWIO via explicit transposes, letting XLA's transpose mover fold them
+# into neighbors where the TPU's native NHWC tiling wins. Measured, not
+# assumed — see PERF_NOTES.md for which configs (if any) it helps.
+_CONV_LAYOUT = "NCHW"
+
+
+def set_conv_layout(layout: str) -> None:
+    """Selects the internal conv layout ("NCHW" default, or "NHWC").
+
+    Process-global and read at trace time: call before building/jitting a
+    learner. Affects only the internal conv lowering; inputs and outputs
+    remain NCHW either way."""
+    global _CONV_LAYOUT
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(f"unknown conv layout {layout!r}")
+    _CONV_LAYOUT = layout
+
 
 def conv2d(
     x: jax.Array,
@@ -35,6 +55,19 @@ def conv2d(
     Returns:
       Output of shape ``(N, O, H', W')``.
     """
+    if _CONV_LAYOUT == "NHWC":
+        out = lax.conv_general_dilated(
+            x.transpose(0, 2, 3, 1),
+            weight.astype(x.dtype).transpose(2, 3, 1, 0),
+            window_strides=(stride, stride),
+            padding=((padding, padding), (padding, padding)),
+            rhs_dilation=(dilation, dilation),
+            feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if bias is not None:
+            out = out + bias.astype(out.dtype)[None, None, None, :]
+        return out.transpose(0, 3, 1, 2).astype(x.dtype)
     out = lax.conv_general_dilated(
         x,
         weight.astype(x.dtype),  # params stored fp32; compute may be bf16

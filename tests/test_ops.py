@@ -42,6 +42,29 @@ def test_conv2d_stride2_no_padding(rng):
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4)
 
 
+def test_conv2d_nhwc_layout_matches_nchw(rng):
+    """The NHWC layout-experiment switch (ops/conv.set_conv_layout) must be
+    numerically equivalent — same NCHW external contract, different internal
+    lowering (VERDICT r3 next #2)."""
+    from howtotrainyourmamlpytorch_tpu.ops import conv as conv_ops
+
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    w = rng.randn(5, 3, 3, 3).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    ref = conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                 stride=2, padding=1)
+    conv_ops.set_conv_layout("NHWC")
+    try:
+        alt = conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                     stride=2, padding=1)
+    finally:
+        conv_ops.set_conv_layout("NCHW")
+    assert alt.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(alt), np.asarray(ref), atol=1e-4)
+    with pytest.raises(ValueError):
+        conv_ops.set_conv_layout("NCWH")
+
+
 def test_linear_matches_torch(rng):
     x = rng.randn(4, 16).astype(np.float32)
     w = rng.randn(5, 16).astype(np.float32)

@@ -1,0 +1,67 @@
+"""Generates a SYNTHETIC pre-split mini-ImageNet-shaped dataset tree.
+
+The real mini-ImageNet images are absent from this environment (only the
+index JSONs exist; the reference's README.md:34-40 assumes a download that
+cannot happen here). This tool writes a tree with the exact layout, split,
+and scale the real dataset has — ``<root>/{train,val,test}/<class>/<i>.png``
+with 64/16/20 classes x 600 images of 84x84 RGB — so the full L4-L5 path
+(pre-split loader, RGB /255 + ImageNet-normalization pipeline, episode
+synthesis, training, checkpoints, ensemble eval) can be exercised at
+north-star shapes end to end (VERDICT r3 next #5).
+
+Images are class-correlated noise (a per-class prototype plus per-image
+jitter), so episodes are learnable and training visibly reduces loss;
+ACCURACY NUMBERS FROM THIS DATA ARE MEANINGLESS for comparison with the
+paper — the run record is the deliverable, not the accuracy.
+
+Usage: python tools/make_synth_imagenet.py [--root datasets/synth_mini_imagenet]
+       [--imgs-per-class 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+from PIL import Image
+
+# The real dataset's split (train_val_test_split [0.64, 0.16, 0.2] of 100).
+SPLIT = {"train": 64, "val": 16, "test": 20}
+SIZE = 84
+
+
+def make_tree(root: str, imgs_per_class: int = 600, seed: int = 7) -> int:
+    rng = np.random.RandomState(seed)
+    total = 0
+    for set_name, n_classes in SPLIT.items():
+        for c in range(n_classes):
+            d = os.path.join(root, set_name, f"synth_{set_name}{c:04d}")
+            os.makedirs(d, exist_ok=True)
+            # Low-frequency per-class prototype (upsampled coarse noise) so
+            # classes are separable but images within a class vary.
+            coarse = rng.randint(0, 256, (7, 7, 3))
+            proto = np.repeat(np.repeat(coarse, 12, axis=0), 12, axis=1)
+            for i in range(imgs_per_class):
+                img = np.clip(
+                    proto + rng.randint(-40, 41, proto.shape), 0, 255
+                ).astype(np.uint8)
+                Image.fromarray(img, mode="RGB").save(
+                    os.path.join(d, f"{i}.png")
+                )
+                total += 1
+    return total
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--root", default="datasets/synth_mini_imagenet")
+    parser.add_argument("--imgs-per-class", type=int, default=600)
+    parser.add_argument("--seed", type=int, default=7)
+    args = parser.parse_args()
+    n = make_tree(args.root, args.imgs_per_class, args.seed)
+    print(f"wrote {n} images under {args.root}")
+
+
+if __name__ == "__main__":
+    main()
