@@ -5,4 +5,4 @@ export DEVICE_ID=$1
 echo $DEVICE_ID
 cd ..
 export DATASET_DIR="datasets/"
-python train_maml_system.py --name_of_args_json_file experiment_config/mini-imagenet_maml++-mini-imagenet_5_2_0.01_48_5_1.json --gpu_to_use $DEVICE_ID
+python train_maml_system.py --name_of_args_json_file experiment_config/mini-imagenet_maml++-mini-imagenet_5_2_0.01_48_5_1.json --gpu_to_use $DEVICE_ID --use_pallas_fused_norm True

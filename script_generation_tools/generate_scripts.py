@@ -59,6 +59,16 @@ def main() -> None:
         # and 4x less tunnel-client leak (PERF_NOTES.md).
         if "omniglot" in cfg.get("dataset_name", "").lower():
             lines[-1] = lines[-1].rstrip("\n") + " --transfer_dtype uint8\n"
+        # The Pallas fused bn+leaky_relu kernel wins 1.28x on the MAML++
+        # EVAL path (the only path the maml learner gates it onto; the
+        # second-order train step keeps the lax norm) but measurably LOSES
+        # on the GD (0.93x) and matching-nets (0.77x) training paths —
+        # tools/pallas_bench.py, PERF_NOTES.md. Enable it only for the MAML
+        # entry point.
+        if MODEL_TO_SCRIPT.get(model, DEFAULT_SCRIPT) == DEFAULT_SCRIPT:
+            lines[-1] = (
+                lines[-1].rstrip("\n") + " --use_pallas_fused_norm True\n"
+            )
         out = os.path.join(
             LOCAL_SCRIPT_DIR, "{}_{}.sh".format(file.replace(".json", ""), PREFIX)
         )
