@@ -5,4 +5,4 @@ export DEVICE_ID=$1
 echo $DEVICE_ID
 cd ..
 export DATASET_DIR="datasets/"
-python train_maml_system.py --name_of_args_json_file experiment_config/omniglot_maml++-omniglot_1_8_0.1_64_20_0.json --gpu_to_use $DEVICE_ID --matmul_precision highest --transfer_dtype uint8 --use_pallas_fused_norm True
+python train_maml_system.py --name_of_args_json_file experiment_config/omniglot_maml++-omniglot_1_8_0.1_64_20_0.json --gpu_to_use $DEVICE_ID --matmul_precision highest --transfer_dtype uint8 --iters_per_dispatch 25 --use_pallas_fused_norm True
