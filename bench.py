@@ -454,6 +454,28 @@ def main() -> None:
         im_epoch,
     )
 
+    # Same north-star program with the second-order-capable fused Pallas
+    # norm stack on the train path (fused_norm_train, optionally + the
+    # fused max-pool epilogue) — the regime is activation-traffic bound at
+    # ~3.8% MFU, and these two keys track whether the fused stack moves it
+    # (PERF_NOTES.md "Second-order fused normalization stack").
+    def _im_fused_rate(**backbone_kwargs):
+        cfg_v = dataclasses.replace(
+            imagenet_cfg,
+            backbone=dataclasses.replace(
+                imagenet_cfg.backbone, **backbone_kwargs
+            ),
+        )
+        value_v, *_rest = _measure(
+            cfg_v, repeats=30, batch_size=2, shots=5, targets_per_class=15
+        )
+        return value_v
+
+    im_fused_value = _im_fused_rate(fused_norm_train=True)
+    im_fused_pool_value = _im_fused_rate(
+        fused_norm_train=True, fused_norm_pool=True
+    )
+
     real = _measure_real_data()
     real_per_iter, real_k25 = real if real is not None else (None, None)
     sentinel_after_ms = _sentinel_ms()
@@ -516,6 +538,14 @@ def main() -> None:
                 "imagenet_shape_mfu": (
                     round(im_value * im_flops / chip_peak_flops, 6)
                     if im_flops else None
+                ),
+                # Second-order fused norm stack on the same program
+                # (flags off by default pending a >=1.1x quiet-chip win).
+                "imagenet_shape_fused_train_meta_iters_per_s": round(
+                    im_fused_value, 2
+                ),
+                "imagenet_shape_fused_train_pool_meta_iters_per_s": round(
+                    im_fused_pool_value, 2
                 ),
                 # Contention sentinel (VERDICT r2 weak #1): a fixed tiny
                 # program timed before/after; poisoned numbers self-label.

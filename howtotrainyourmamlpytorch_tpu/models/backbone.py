@@ -85,13 +85,28 @@ class BackboneConfig:
     image_width: int = 28
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
-    # Fused Pallas bn+leaky_relu kernel (ops/pallas_fused_norm.py). Its
-    # custom_vjp supports ONE level of reverse-mode AD: valid for MAML eval
-    # (inner grad only) and the GD/matching-nets baselines (single outer
-    # grad). MAML *training* — even first-order — differentiates the inner
-    # value_and_grad again with the outer meta-gradient, so MAML passes
-    # fused=False on every train path (models/maml.py outer_grad flag).
+    # Fused Pallas bn+leaky_relu kernels (ops/pallas_fused_norm.py) — one
+    # independent knob per grad regime, so each consumer path enables the
+    # kernel only where it measures a win (PERF_NOTES.md):
+    #
+    # * use_pallas_fused_norm — the ONE-LEVEL-reverse-AD variant
+    #   ("vjp": Pallas forward AND backward kernels behind jax.custom_vjp).
+    #   Consumers: MAML eval (inner grad only; the measured 1.28x win) and
+    #   the GD / matching-nets baselines (single outer grad; measured
+    #   slower there, scripts leave it off).
+    # * fused_norm_train — the SECOND-ORDER-CAPABLE variant ("jvp": Pallas
+    #   forward behind a recursive jax.custom_jvp with lax tangents,
+    #   differentiable to any order). Consumers: the MAML/MAML++ TRAIN
+    #   paths (reverse-over-reverse — the outer meta-gradient over the
+    #   inner value_and_grad), which no custom_vjp survives.
+    # * fused_norm_pool — extends the fused boundary through the backbone's
+    #   2x2/2 max pool (norm -> leaky_relu -> max_pool epilogue) on stages
+    #   whose post-conv H, W are even, wherever a fused variant is active.
+    #   Pool fusion is built on the "jvp" op (any-order AD), so it is legal
+    #   on every path.
     use_pallas_fused_norm: bool = False
+    fused_norm_train: bool = False
+    fused_norm_pool: bool = False
 
     @property
     def conv_stride(self) -> int:
@@ -231,7 +246,7 @@ class VGGBackbone:
         step,
         *,
         training: bool = True,
-        fused: bool | None = None,
+        fused: "bool | str | None" = None,
     ) -> tuple[jax.Array, Params]:
         """Forward pass.
 
@@ -243,6 +258,9 @@ class VGGBackbone:
           training: Kept for API symmetry; like the reference, normalization
             always uses batch statistics regardless of phase
             (``meta_neural_network_architectures.py:246-247``).
+          fused: Fused-norm variant: ``None`` (config default), ``False`` /
+            ``"off"``, ``True`` / ``"vjp"`` (one-level-AD kernel pair), or
+            ``"jvp"`` (second-order-capable kernel; see ``BackboneConfig``).
 
         Returns:
           ``(logits (N, num_classes), new_bn_state)``.
@@ -251,10 +269,9 @@ class VGGBackbone:
         cfg = self.cfg
         # The fused kernel covers the adjacent bn+leaky_relu pair, which only
         # exists in the conv_norm ordering.
-        use_fused = (
-            (cfg.use_pallas_fused_norm if fused is None else fused)
-            and cfg.block_order == "conv_norm"
-        )
+        variant = resolve_fused_variant(cfg, fused)
+        if cfg.block_order != "conv_norm":
+            variant = "off"
         new_bn_state: Params = {}
         out = x
 
@@ -267,19 +284,21 @@ class VGGBackbone:
                 padding=cfg.conv_padding,
             )
 
-        def run_norm(out, stage, i):
-            """Normalization (+ activation when fused). Returns (out, done)
-            where done means the activation is already applied."""
+        def run_norm(out, stage, i, pool):
+            """Normalization (+ activation / pooling when fused). Returns
+            ``(out, activated, pooled)``."""
             if cfg.norm_layer == "batch_norm":
-                if use_fused:
+                if variant != "off":
                     out, new_bn_state[f"conv{i}"] = self._fused_norm_act(
                         out,
                         stage["norm"]["gamma"],
                         stage["norm"]["beta"],
                         bn_state[f"conv{i}"],
                         step,
+                        variant=variant,
+                        pool=pool,
                     )
-                    return out, True
+                    return out, True, pool
                 out, new_bn_state[f"conv{i}"] = batch_norm(
                     out,
                     stage["norm"]["gamma"],
@@ -293,22 +312,36 @@ class VGGBackbone:
                 out = layer_norm(
                     out, stage["norm"]["weight"], stage["norm"]["bias"], eps=cfg.bn_eps
                 )
-            return out, False
+            return out, False, False
 
         for i in range(cfg.num_stages):
             stage = params[f"conv{i}"]
+            pooled = False
             if cfg.block_order == "norm_conv":
                 # C7 ordering: norm(stage input) -> conv -> LeakyReLU
                 # (meta_neural_network_architectures.py:525-533).
-                out, _ = run_norm(out, stage, i)
+                out, _, _ = run_norm(out, stage, i, False)
                 out = run_conv(out, stage)
                 out = jax.nn.leaky_relu(out, negative_slope=0.01)
             else:
                 out = run_conv(out, stage)
-                out, activated = run_norm(out, stage, i)
+                # Fuse the stage's 2x2 max pool into the norm kernel where
+                # the epilogue is exact: torch floor-mode pooling drops the
+                # trailing row/col at odd sizes while BN statistics still
+                # cover them, so odd stages keep the separate pool.
+                h, w = self._pre_pool_shape(i)
+                fuse_pool = (
+                    cfg.fused_norm_pool
+                    and cfg.max_pooling
+                    and variant != "off"
+                    and cfg.norm_layer == "batch_norm"
+                    and h % 2 == 0
+                    and w % 2 == 0
+                )
+                out, activated, pooled = run_norm(out, stage, i, fuse_pool)
                 if not activated:
                     out = jax.nn.leaky_relu(out, negative_slope=0.01)
-            if cfg.max_pooling:
+            if cfg.max_pooling and not pooled:
                 out = max_pool2d(out, 2, 2)
 
         if not cfg.max_pooling:
@@ -318,11 +351,12 @@ class VGGBackbone:
         logits = linear(out, params["linear"]["weight"], params["linear"]["bias"])
         return logits, new_bn_state
 
-    def _fused_norm_act(self, x, gamma, beta, state, step):
+    def _fused_norm_act(self, x, gamma, beta, state, step, *, variant, pool):
         cfg = self.cfg
         return fused_norm_act(
             x, gamma, beta, state, step,
             eps=cfg.bn_eps, momentum=cfg.bn_momentum,
+            variant=variant, pool=pool,
         )
 
     # ------------------------------------------------------------------
@@ -350,12 +384,45 @@ def _map_with_path(fn, tree: Params, path: tuple[str, ...] = ()) -> Params:
     return fn(path, tree)
 
 
-def fused_norm_act(x, gamma, beta, state, step, *, eps, momentum, slope=0.01):
-    """Pallas fused bn+leaky_relu + the same running-stat update as
-    ``ops/norm.batch_norm`` (torch semantics: unbiased var, momentum mix),
-    with per-step row select/scatter. Shared by the VGG and ResNet-12
-    backbones; one-level-AD only (see ``use_pallas_fused_norm``)."""
-    from ..ops.pallas_fused_norm import fused_bn_leaky_relu
+def resolve_fused_variant(cfg: BackboneConfig, fused) -> str:
+    """Maps an ``apply(fused=...)`` argument to a concrete variant name.
+
+    ``None`` falls back to the config: ``"vjp"`` when
+    ``use_pallas_fused_norm`` (the one-level-AD default the baselines and
+    eval consumers measured), else ``"jvp"`` when ``fused_norm_train``
+    (a train-only config still fuses its single-level passes — the jvp op
+    is valid at every order), else ``"off"``. Booleans keep the historical
+    meaning (``True`` = the one-level ``custom_vjp`` kernel pair).
+    """
+    if fused is None:
+        if cfg.use_pallas_fused_norm:
+            return "vjp"
+        return "jvp" if cfg.fused_norm_train else "off"
+    if fused is False:
+        return "off"
+    if fused is True:
+        return "vjp"
+    if fused in ("off", "vjp", "jvp"):
+        return fused
+    raise ValueError(f"unknown fused variant {fused!r}")
+
+
+def fused_norm_act(x, gamma, beta, state, step, *, eps, momentum, slope=0.01,
+                   variant="vjp", pool=False):
+    """Pallas fused bn+leaky_relu [+ 2x2 max pool] + the same running-stat
+    update as ``ops/norm.batch_norm`` (torch semantics: unbiased var,
+    momentum mix), with per-step row select/scatter. Shared by the VGG and
+    ResNet-12 backbones.
+
+    ``variant``: ``"vjp"`` = one-level-AD kernel pair (Pallas fwd+bwd);
+    ``"jvp"`` = second-order-capable op (Pallas fwd, lax tangents). The
+    pooled epilogue is built on the jvp op regardless of ``variant`` (it is
+    valid at every AD order, so one-level consumers may use it too)."""
+    from ..ops.pallas_fused_norm import (
+        fused_bn_leaky_relu,
+        fused_bn_leaky_relu_ho,
+        fused_bn_leaky_relu_pool,
+    )
 
     step = jnp.asarray(step)
     if gamma.ndim == 2:
@@ -365,7 +432,13 @@ def fused_norm_act(x, gamma, beta, state, step, *, eps, momentum, slope=0.01):
         gamma_row, beta_row = gamma, beta
     # Interpreter mode off-TPU (CPU tests); real kernels otherwise.
     interpret = jax.default_backend() == "cpu"
-    out, mean, var = fused_bn_leaky_relu(
+    if pool:
+        op = fused_bn_leaky_relu_pool
+    elif variant == "jvp":
+        op = fused_bn_leaky_relu_ho
+    else:
+        op = fused_bn_leaky_relu
+    out, mean, var = op(
         x, gamma_row.astype(jnp.float32), beta_row.astype(jnp.float32),
         eps, slope, interpret,
     )

@@ -477,15 +477,21 @@ class MAMLFewShotLearner(CheckpointableLearner):
         x_target = decode_images(x_target, self.cfg.wire_codec, compute_dtype)
         if final_only:
             assert pred_step is None or pred_step == num_steps - 1
-        # The fused Pallas norm kernel's custom_vjp supports ONE level of
-        # reverse-mode AD. The support forward already sits under the inner
-        # ``value_and_grad``, so taking the outer meta-gradient over it —
-        # even first-order, via the BN-state/fast-weight carry — is
-        # reverse-over-reverse and fails to linearize. Fused therefore only
-        # when no outer grad is taken: evaluation here, and the GD /
-        # matching-nets baselines, whose single ``value_and_grad`` calls
-        # ``backbone.apply`` with the config default directly.
-        fused = backbone.cfg.use_pallas_fused_norm and not outer_grad
+        # Per-consumer fused-norm gating (BackboneConfig docstring). The
+        # one-level custom_vjp kernel pair ("vjp") only survives a single
+        # reverse-mode pass, so it is legal on evaluation alone (the inner
+        # value_and_grad is the only differentiation). Train paths — even
+        # first-order, via the BN-state/fast-weight carry — take the outer
+        # meta-gradient over the inner value_and_grad (reverse-over-reverse)
+        # and require the second-order-capable "jvp" op, gated by its own
+        # knob so each path flips only on a measured win. The GD /
+        # matching-nets baselines call ``backbone.apply`` with the config
+        # default directly.
+        bb = backbone.cfg
+        if outer_grad:
+            fused = "jvp" if bb.fused_norm_train else "off"
+        else:
+            fused = "vjp" if bb.use_pallas_fused_norm else "off"
 
         def step_fn(carry, step):
             fast, bn = carry

@@ -41,7 +41,13 @@ import jax.numpy as jnp
 
 from ..ops import batch_norm, conv2d, linear, max_pool2d, xavier_uniform
 from ..ops.norm import init_batch_norm_state
-from .backbone import BackboneConfig, Params, _map_with_path, fused_norm_act
+from .backbone import (
+    BackboneConfig,
+    Params,
+    _map_with_path,
+    fused_norm_act,
+    resolve_fused_variant,
+)
 
 LEAKY_SLOPE = 0.1  # few-shot ResNet-12 convention (vs the VGG net's 0.01)
 
@@ -140,28 +146,32 @@ class ResNet12Backbone:
         step,
         *,
         training: bool = True,
-        fused: bool | None = None,
+        fused: "bool | str | None" = None,
     ) -> tuple[jax.Array, Params]:
         """Forward pass ``(N, C, H, W) -> (logits, new_bn_state)``.
 
         Like the VGG backbone (and the reference's always-``training=True``
         BN call), normalization uses the current batch statistics in every
         phase; the returned state is diagnostic. The Pallas fused
-        bn+leaky_relu kernel covers the two adjacent bn->activation pairs
+        bn+leaky_relu kernel (``fused`` variant semantics as in
+        ``VGGBackbone.apply``) covers the two adjacent bn->activation pairs
         inside each stage (conv0/conv1); conv2's BN feeds the residual add
-        and the shortcut BN is unactivated, so both always take the lax path.
+        and the shortcut BN is unactivated, so both always take the lax
+        path, and the stage pool follows the residual add, so the pooled
+        epilogue never applies here.
         """
         del training
         cfg = self.cfg
-        use_fused = cfg.use_pallas_fused_norm if fused is None else fused
+        variant = resolve_fused_variant(cfg, fused)
         new_bn_state: Params = {}
         out = x
 
         def norm(h, unit, state, *, activate):
-            if use_fused and activate:
+            if variant != "off" and activate:
                 return fused_norm_act(
                     h, unit["norm"]["gamma"], unit["norm"]["beta"], state, step,
                     eps=cfg.bn_eps, momentum=cfg.bn_momentum, slope=LEAKY_SLOPE,
+                    variant=variant,
                 )
             h, new_state = batch_norm(
                 h, unit["norm"]["gamma"], unit["norm"]["beta"], state, step,

@@ -1,32 +1,54 @@
-"""Pallas TPU kernel: fused batch-norm (batch statistics) + LeakyReLU.
+"""Pallas TPU kernels: fused batch-norm (batch statistics) + LeakyReLU,
+with a second-order-capable variant and an optional 2x2 max-pool epilogue.
 
-The backbone's hot elementwise chain is ``conv -> batch_norm -> leaky_relu``
-(reference ``meta_neural_network_architectures.py:385-426``; our
-``models/backbone.py``). XLA fuses the affine/activation pieces but still
-materializes the normalization as separate reduction + map ops; this kernel
-performs the whole stats+normalize+affine+activation chain in ONE VMEM
-round trip: the activation block is loaded once, per-channel mean/variance
-are reduced on the VPU, and the normalized, scaled, shifted, activated
-result is written straight back — plus the batch mean/var as byproducts for
-the running-statistics update.
+The backbone's hot elementwise chain is ``conv -> batch_norm -> leaky_relu
+[-> max_pool]`` (reference ``meta_neural_network_architectures.py:385-426``;
+our ``models/backbone.py``). XLA fuses the affine/activation pieces but
+still materializes the normalization as separate reduction + map ops; these
+kernels perform the whole stats+normalize+affine+activation chain in as few
+VMEM round trips as the activation size allows, and return the batch
+mean/var as byproducts for the running-statistics update.
 
 Layout: the (N, C, H, W) activation is viewed as (R, C) with R = N*H*W so
 the channel axis rides the 128-wide lane dimension. Both R and C are padded
-to the fp32 (8, 128) tile.
+to the fp32 (8, 128) tile. Activations whose 2-D view exceeds the VMEM
+budget (the mini-ImageNet 84x84 stages: ~90 MB at the north-star shapes)
+take a row-blocked two-phase path — a grid pass accumulating per-block
+partial sums for the statistics, then a grid pass applying
+normalize+affine+activation per block — instead of the one-pass
+whole-array kernel that small (Omniglot-sized) activations use.
 
-Differentiation: exposed via ``jax.custom_vjp`` with the backward pass as a
-second Pallas kernel (standard batch-norm backward through the batch
-statistics, fused with the LeakyReLU mask). ``custom_vjp`` supports ONE
-level of reverse-mode AD — enough for MAML evaluation (the inner-loop
-``value_and_grad`` is the only differentiation) and for the GD and
-matching-nets baselines (one outer grad). MAML *training* — second order
-or first — takes the outer meta-gradient over the inner ``value_and_grad``,
-which is reverse-over-reverse; those paths keep the pure-lax
-``ops/norm.batch_norm``, which XLA differentiates natively to any order
-(``models/maml.py`` selects per-path via its ``outer_grad`` flag).
+Differentiation — THREE public ops, one per AD regime:
+
+* ``fused_bn_leaky_relu`` — ``jax.custom_vjp`` with the backward pass as a
+  second Pallas kernel (batch-norm backward through the batch statistics,
+  fused with the LeakyReLU mask). ONE level of reverse-mode AD: the MAML
+  evaluation path (the inner ``value_and_grad`` is the only
+  differentiation) and the GD / matching-nets baselines (one outer grad).
+  This is the variant with the measured 1.28x eval win (PERF_NOTES.md).
+* ``fused_bn_leaky_relu_ho`` — ``jax.custom_jvp`` whose rule recomputes the
+  primal THROUGH THE OP ITSELF (so arbitrarily deep traces re-enter the
+  rule and the Pallas call only ever sees fully-primal values) and
+  expresses the tangent in lax, which XLA differentiates/transposes to any
+  order. Legal inside the reverse-over-reverse MAML/MAML++ train step —
+  every forward instance (including remat recomputes and the forwards
+  inside the inner-grad linearization) runs the fused kernel; derivative
+  paths run XLA-fused lax. (A naive ``custom_vjp`` — even one whose
+  backward is pure lax, or a nested VJP-of-VJP — dies in the outer
+  linearization: ``pallas_call`` has a JVP rule but no partial-eval rule,
+  so the second differentiation level hits ``linearize``'s known-primal
+  assertion. Verified empirically on jax 0.4.37.)
+* ``fused_bn_leaky_relu_pool`` — the HO form with the fusion boundary
+  extended through the backbone's 2x2/2 max pool: the kernel consumes the
+  four strided views that partition the pool windows and writes the pooled
+  activation directly, quartering the normalized-activation HBM write
+  traffic. Requires even H and W (callers fall back per stage otherwise).
 
 Numerics: statistics and normalization are computed in fp32 regardless of
-input dtype (bf16-safe), matching ``ops/norm.batch_norm``.
+input dtype (bf16-safe), matching ``ops/norm.batch_norm``. Tangent-path
+LeakyReLU masks and pool argmax selection are derived from lax-recomputed
+pre-activations (a consistent linearization of a function that agrees with
+the kernel output to ~1 ulp).
 """
 
 from __future__ import annotations
@@ -38,13 +60,33 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Row-blocked dispatch threshold: when the resident row-sized arrays of a
+# single-block kernel would exceed this many bytes, the op switches to the
+# two-phase grid path. ~8 MB leaves headroom in 16 MB VMEM for Mosaic's own
+# buffers; tests monkeypatch this down to force the blocked path at CPU
+# shapes.
+_MAX_RESIDENT_BYTES = 8 * 1024 * 1024
+
 
 def _round_up(value: int, multiple: int) -> int:
     return (value + multiple - 1) // multiple * multiple
 
 
+def _block_plan(rows_padded: int, cols_padded: int, n_arrays: int) -> int | None:
+    """None = whole-array single block; else rows per grid block (mult. of 8).
+
+    ``n_arrays`` counts the row-sized (R, C) arrays resident at once in the
+    kernel (inputs + outputs); (1, C) broadcasts are negligible.
+    """
+    per_row = cols_padded * 4 * n_arrays
+    if rows_padded * per_row <= _MAX_RESIDENT_BYTES:
+        return None
+    block = max(8, _MAX_RESIDENT_BYTES // per_row // 8 * 8)
+    return min(block, rows_padded)
+
+
 # ---------------------------------------------------------------------------
-# Forward kernel
+# Single-block (one-pass) kernels — small activations
 # ---------------------------------------------------------------------------
 
 
@@ -52,7 +94,6 @@ def _fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref,
                 *, rows: int, eps: float, slope: float):
     """One block: x (Rp, Cp) fp32 in VMEM; rows = real R (Rp-rows padding)."""
     x = x_ref[:].astype(jnp.float32)
-    rp = x.shape[0]
     # Mask padded rows out of the statistics.
     row_ids = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
     valid = row_ids < rows
@@ -67,11 +108,6 @@ def _fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref,
     y_ref[:] = y.astype(y_ref.dtype)
     mean_ref[:] = mean
     var_ref[:] = var
-
-
-# ---------------------------------------------------------------------------
-# Backward kernel
-# ---------------------------------------------------------------------------
 
 
 def _bwd_kernel(x_ref, gamma_ref, beta_ref, mean_ref, var_ref, g_ref,
@@ -108,8 +144,144 @@ def _bwd_kernel(x_ref, gamma_ref, beta_ref, mean_ref, var_ref, g_ref,
     dbeta_ref[:] = dbeta
 
 
+def _fwd_pool_kernel(x0_ref, x1_ref, x2_ref, x3_ref, gamma_ref, beta_ref,
+                     y_ref, mean_ref, var_ref,
+                     *, rows: int, eps: float, slope: float):
+    """One-pass fused norm+act+2x2 max pool over the four strided views that
+    partition the pool windows (each (R2p, Cp); rows = real R2). Statistics
+    run over all four views (= the full pre-pool activation)."""
+    xs = [r[:].astype(jnp.float32) for r in (x0_ref, x1_ref, x2_ref, x3_ref)]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, xs[0].shape, 0)
+    valid = row_ids < rows
+    inv_n = 1.0 / (4 * rows)
+    total = jnp.zeros((1, xs[0].shape[1]), jnp.float32)
+    total_sq = total
+    for x in xs:
+        xm = jnp.where(valid, x, 0.0)
+        total = total + jnp.sum(xm, axis=0, keepdims=True)
+        total_sq = total_sq + jnp.sum(xm * x, axis=0, keepdims=True)
+    mean = total * inv_n
+    var = total_sq * inv_n - mean * mean
+    inv = jax.lax.rsqrt(var + eps)
+    gamma = gamma_ref[:]
+    beta = beta_ref[:]
+    y = None
+    for x in xs:
+        pre = (x - mean) * inv * gamma + beta
+        yi = jnp.where(pre >= 0, pre, slope * pre)
+        y = yi if y is None else jnp.maximum(y, yi)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    var_ref[:] = var
+
+
 # ---------------------------------------------------------------------------
-# Host-side wrappers (2-D padded views)
+# Row-blocked (two-phase) kernels — large activations
+# ---------------------------------------------------------------------------
+
+
+def _stats_block_kernel(x_ref, sum_ref, sq_ref, *, rows: int, block_rows: int):
+    """Grid phase 1: per-block partial sum / sum-of-squares, valid-masked."""
+    i = pl.program_id(0)
+    x = x_ref[:].astype(jnp.float32)
+    row_ids = i * block_rows + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    valid = row_ids < rows
+    xm = jnp.where(valid, x, 0.0)
+    sum_ref[:] = jnp.sum(xm, axis=0, keepdims=True)
+    sq_ref[:] = jnp.sum(xm * x, axis=0, keepdims=True)
+
+
+def _apply_block_kernel(x_ref, gamma_ref, beta_ref, mean_ref, var_ref, y_ref,
+                        *, eps: float, slope: float):
+    """Grid phase 2: normalize+affine+activate one row block. Padded rows
+    produce garbage that the caller slices off; padded channels see
+    gamma = 0 so stay finite."""
+    x = x_ref[:].astype(jnp.float32)
+    inv = jax.lax.rsqrt(var_ref[:] + eps)
+    pre = (x - mean_ref[:]) * inv * gamma_ref[:] + beta_ref[:]
+    y = jnp.where(pre >= 0, pre, slope * pre)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _bwd_stats_block_kernel(x_ref, gamma_ref, beta_ref, mean_ref, var_ref,
+                            g_ref, s1_ref, s2_ref,
+                            *, rows: int, block_rows: int, eps: float,
+                            slope: float):
+    """Backward grid phase 1: partial sums of dpre and dpre*xhat per block.
+    Their totals ARE dbeta / dgamma and (scaled by gamma) the two reduction
+    terms of the batch-norm dx formula."""
+    i = pl.program_id(0)
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    inv = jax.lax.rsqrt(var_ref[:] + eps)
+    xhat = (x - mean_ref[:]) * inv
+    pre = xhat * gamma_ref[:] + beta_ref[:]
+    dpre = jnp.where(pre >= 0, g, slope * g)
+    row_ids = i * block_rows + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    dpre = jnp.where(row_ids < rows, dpre, 0.0)
+    s1_ref[:] = jnp.sum(dpre, axis=0, keepdims=True)
+    s2_ref[:] = jnp.sum(dpre * xhat, axis=0, keepdims=True)
+
+
+def _bwd_apply_block_kernel(x_ref, gamma_ref, beta_ref, mean_ref, var_ref,
+                            g_ref, t1_ref, t2_ref, dx_ref,
+                            *, rows: int, eps: float, slope: float):
+    """Backward grid phase 2: dx for one row block from the phase-1 totals
+    (t1 = sum dpre, t2 = sum dpre*xhat, both (1, Cp))."""
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    gamma = gamma_ref[:]
+    inv = jax.lax.rsqrt(var_ref[:] + eps)
+    xhat = (x - mean_ref[:]) * inv
+    pre = xhat * gamma + beta_ref[:]
+    dpre = jnp.where(pre >= 0, g, slope * g)
+    inv_n = 1.0 / rows
+    dxhat = dpre * gamma
+    dx = inv * (
+        dxhat
+        - inv_n * gamma * t1_ref[:]
+        - xhat * inv_n * gamma * t2_ref[:]
+    )
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _stats_pool_block_kernel(x0_ref, x1_ref, x2_ref, x3_ref, sum_ref, sq_ref,
+                             *, rows: int, block_rows: int):
+    """Pooled variant of phase 1: partials over all four views' blocks."""
+    i = pl.program_id(0)
+    row_ids = i * block_rows + jax.lax.broadcasted_iota(
+        jnp.int32, x0_ref.shape, 0
+    )
+    valid = row_ids < rows
+    total = jnp.zeros((1, x0_ref.shape[1]), jnp.float32)
+    total_sq = total
+    for r in (x0_ref, x1_ref, x2_ref, x3_ref):
+        x = r[:].astype(jnp.float32)
+        xm = jnp.where(valid, x, 0.0)
+        total = total + jnp.sum(xm, axis=0, keepdims=True)
+        total_sq = total_sq + jnp.sum(xm * x, axis=0, keepdims=True)
+    sum_ref[:] = total
+    sq_ref[:] = total_sq
+
+
+def _apply_pool_block_kernel(x0_ref, x1_ref, x2_ref, x3_ref, gamma_ref,
+                             beta_ref, mean_ref, var_ref, y_ref,
+                             *, eps: float, slope: float):
+    """Pooled variant of phase 2: norm+act+max over the four view blocks."""
+    inv = jax.lax.rsqrt(var_ref[:] + eps)
+    gamma = gamma_ref[:]
+    beta = beta_ref[:]
+    mean = mean_ref[:]
+    y = None
+    for r in (x0_ref, x1_ref, x2_ref, x3_ref):
+        pre = (r[:].astype(jnp.float32) - mean) * inv * gamma + beta
+        yi = jnp.where(pre >= 0, pre, slope * pre)
+        y = yi if y is None else jnp.maximum(y, yi)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrappers (2-D padded views, single-block vs blocked dispatch)
 # ---------------------------------------------------------------------------
 
 
@@ -117,54 +289,241 @@ def _pad2d(a: jax.Array, rp: int, cp: int) -> jax.Array:
     return jnp.pad(a, ((0, rp - a.shape[0]), (0, cp - a.shape[1])))
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "slope", "interpret"))
+def _pad_params(gamma, beta, cp):
+    gp = jnp.pad(gamma, (0, cp - gamma.shape[0])).astype(jnp.float32)[None, :]
+    bp = jnp.pad(beta, (0, cp - beta.shape[0])).astype(jnp.float32)[None, :]
+    return gp, bp
+
+
+def _row_block_specs(n, block_rows, cp):
+    """n row-blocked input specs followed by callers' (1, Cp) broadcasts."""
+    return [pl.BlockSpec((block_rows, cp), lambda i: (i, 0))] * n
+
+
+def _bcast_spec(cp):
+    return pl.BlockSpec((1, cp), lambda i: (0, 0))
+
+
 def _fused_fwd_2d(x2d, gamma, beta, *, eps, slope, interpret):
     rows, cols = x2d.shape
     rp, cp = _round_up(rows, 8), _round_up(cols, 128)
+    # x + y resident in the one-pass kernel.
+    block_rows = _block_plan(rp, cp, n_arrays=2)
+    return _fused_fwd_2d_impl(
+        x2d, gamma, beta,
+        eps=eps, slope=slope, interpret=interpret, block_rows=block_rows,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eps", "slope", "interpret", "block_rows")
+)
+def _fused_fwd_2d_impl(x2d, gamma, beta, *, eps, slope, interpret, block_rows):
+    rows, cols = x2d.shape
+    cp = _round_up(cols, 128)
+    gp, bp = _pad_params(gamma, beta, cp)
+    if block_rows is None:
+        rp = _round_up(rows, 8)
+        y, mean, var = pl.pallas_call(
+            functools.partial(_fwd_kernel, rows=rows, eps=eps, slope=slope),
+            out_shape=(
+                jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+                jax.ShapeDtypeStruct((1, cp), jnp.float32),
+                jax.ShapeDtypeStruct((1, cp), jnp.float32),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+            interpret=interpret,
+        )(_pad2d(x2d, rp, cp), gp, bp)
+        return y[:rows, :cols], mean[0, :cols], var[0, :cols]
+
+    rp = _round_up(rows, block_rows)
+    nb = rp // block_rows
     xp = _pad2d(x2d, rp, cp)
-    gp = jnp.pad(gamma, (0, cp - cols)).astype(jnp.float32)[None, :]
-    bp = jnp.pad(beta, (0, cp - cols)).astype(jnp.float32)[None, :]
-    y, mean, var = pl.pallas_call(
-        functools.partial(_fwd_kernel, rows=rows, eps=eps, slope=slope),
-        out_shape=(
-            jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
-            jax.ShapeDtypeStruct((1, cp), jnp.float32),
-            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+    sums, sqs = pl.pallas_call(
+        functools.partial(
+            _stats_block_kernel, rows=rows, block_rows=block_rows
         ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
-        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        grid=(nb,),
+        out_shape=(
+            jax.ShapeDtypeStruct((nb, cp), jnp.float32),
+            jax.ShapeDtypeStruct((nb, cp), jnp.float32),
+        ),
+        in_specs=_row_block_specs(1, block_rows, cp),
+        out_specs=(
+            pl.BlockSpec((1, cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, cp), lambda i: (i, 0)),
+        ),
         interpret=interpret,
-    )(xp, gp, bp)
+    )(xp)
+    inv_n = 1.0 / rows
+    mean = jnp.sum(sums, axis=0, keepdims=True) * inv_n
+    var = jnp.sum(sqs, axis=0, keepdims=True) * inv_n - mean * mean
+    y = pl.pallas_call(
+        functools.partial(_apply_block_kernel, eps=eps, slope=slope),
+        grid=(nb,),
+        out_shape=jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+        in_specs=_row_block_specs(1, block_rows, cp)
+        + [_bcast_spec(cp)] * 4,
+        out_specs=pl.BlockSpec((block_rows, cp), lambda i: (i, 0)),
+        interpret=interpret,
+    )(xp, gp, bp, mean, var)
     return y[:rows, :cols], mean[0, :cols], var[0, :cols]
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "slope", "interpret"))
 def _fused_bwd_2d(x2d, gamma, beta, mean, var, g2d, *, eps, slope, interpret):
     rows, cols = x2d.shape
     rp, cp = _round_up(rows, 8), _round_up(cols, 128)
-    xp = _pad2d(x2d, rp, cp)
-    gp = jnp.pad(g2d, ((0, rp - rows), (0, cp - cols)))
-    gamma_p = jnp.pad(gamma, (0, cp - cols)).astype(jnp.float32)[None, :]
-    beta_p = jnp.pad(beta, (0, cp - cols)).astype(jnp.float32)[None, :]
+    # x + g + dx resident in the one-pass kernel.
+    block_rows = _block_plan(rp, cp, n_arrays=3)
+    return _fused_bwd_2d_impl(
+        x2d, gamma, beta, mean, var, g2d,
+        eps=eps, slope=slope, interpret=interpret, block_rows=block_rows,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eps", "slope", "interpret", "block_rows")
+)
+def _fused_bwd_2d_impl(x2d, gamma, beta, mean, var, g2d,
+                       *, eps, slope, interpret, block_rows):
+    rows, cols = x2d.shape
+    cp = _round_up(cols, 128)
+    gamma_p, beta_p = _pad_params(gamma, beta, cp)
     # Padded channels get var=0 -> rsqrt(eps) finite, grads masked by zeros.
     mean_p = jnp.pad(mean, (0, cp - cols)).astype(jnp.float32)[None, :]
     var_p = jnp.pad(var, (0, cp - cols)).astype(jnp.float32)[None, :]
-    dx, dgamma, dbeta = pl.pallas_call(
-        functools.partial(_bwd_kernel, rows=rows, eps=eps, slope=slope),
-        out_shape=(
-            jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
-            jax.ShapeDtypeStruct((1, cp), jnp.float32),
-            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+    if block_rows is None:
+        rp = _round_up(rows, 8)
+        xp = _pad2d(x2d, rp, cp)
+        gp = _pad2d(g2d, rp, cp)
+        dx, dgamma, dbeta = pl.pallas_call(
+            functools.partial(_bwd_kernel, rows=rows, eps=eps, slope=slope),
+            out_shape=(
+                jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+                jax.ShapeDtypeStruct((1, cp), jnp.float32),
+                jax.ShapeDtypeStruct((1, cp), jnp.float32),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+            interpret=interpret,
+        )(xp, gamma_p, beta_p, mean_p, var_p, gp)
+        return dx[:rows, :cols], dgamma[0, :cols], dbeta[0, :cols]
+
+    rp = _round_up(rows, block_rows)
+    nb = rp // block_rows
+    xp = _pad2d(x2d, rp, cp)
+    gp = _pad2d(g2d, rp, cp)
+    s1, s2 = pl.pallas_call(
+        functools.partial(
+            _bwd_stats_block_kernel,
+            rows=rows, block_rows=block_rows, eps=eps, slope=slope,
         ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
-        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        grid=(nb,),
+        out_shape=(
+            jax.ShapeDtypeStruct((nb, cp), jnp.float32),
+            jax.ShapeDtypeStruct((nb, cp), jnp.float32),
+        ),
+        in_specs=_row_block_specs(1, block_rows, cp)
+        + [_bcast_spec(cp)] * 4
+        + _row_block_specs(1, block_rows, cp),
+        out_specs=(
+            pl.BlockSpec((1, cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, cp), lambda i: (i, 0)),
+        ),
         interpret=interpret,
     )(xp, gamma_p, beta_p, mean_p, var_p, gp)
-    return dx[:rows, :cols], dgamma[0, :cols], dbeta[0, :cols]
+    t1 = jnp.sum(s1, axis=0, keepdims=True)  # = dbeta (padded)
+    t2 = jnp.sum(s2, axis=0, keepdims=True)  # = dgamma (padded)
+    dx = pl.pallas_call(
+        functools.partial(
+            _bwd_apply_block_kernel, rows=rows, eps=eps, slope=slope
+        ),
+        grid=(nb,),
+        out_shape=jax.ShapeDtypeStruct((rp, cp), x2d.dtype),
+        in_specs=_row_block_specs(1, block_rows, cp)
+        + [_bcast_spec(cp)] * 4
+        + _row_block_specs(1, block_rows, cp)
+        + [_bcast_spec(cp)] * 2,
+        out_specs=pl.BlockSpec((block_rows, cp), lambda i: (i, 0)),
+        interpret=interpret,
+    )(xp, gamma_p, beta_p, mean_p, var_p, gp, t1, t2)
+    return dx[:rows, :cols], t2[0, :cols], t1[0, :cols]
+
+
+def _fused_pool_fwd_2d(x0, x1, x2, x3, gamma, beta, *, eps, slope, interpret):
+    rows, cols = x0.shape
+    rp, cp = _round_up(rows, 8), _round_up(cols, 128)
+    # 4 views + pooled out resident in the one-pass kernel.
+    block_rows = _block_plan(rp, cp, n_arrays=5)
+    return _fused_pool_fwd_2d_impl(
+        x0, x1, x2, x3, gamma, beta,
+        eps=eps, slope=slope, interpret=interpret, block_rows=block_rows,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eps", "slope", "interpret", "block_rows")
+)
+def _fused_pool_fwd_2d_impl(x0, x1, x2, x3, gamma, beta,
+                            *, eps, slope, interpret, block_rows):
+    rows, cols = x0.shape
+    cp = _round_up(cols, 128)
+    gp, bp = _pad_params(gamma, beta, cp)
+    if block_rows is None:
+        rp = _round_up(rows, 8)
+        views = [_pad2d(v, rp, cp) for v in (x0, x1, x2, x3)]
+        y, mean, var = pl.pallas_call(
+            functools.partial(
+                _fwd_pool_kernel, rows=rows, eps=eps, slope=slope
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((rp, cp), x0.dtype),
+                jax.ShapeDtypeStruct((1, cp), jnp.float32),
+                jax.ShapeDtypeStruct((1, cp), jnp.float32),
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+            interpret=interpret,
+        )(*views, gp, bp)
+        return y[:rows, :cols], mean[0, :cols], var[0, :cols]
+
+    rp = _round_up(rows, block_rows)
+    nb = rp // block_rows
+    views = [_pad2d(v, rp, cp) for v in (x0, x1, x2, x3)]
+    sums, sqs = pl.pallas_call(
+        functools.partial(
+            _stats_pool_block_kernel, rows=rows, block_rows=block_rows
+        ),
+        grid=(nb,),
+        out_shape=(
+            jax.ShapeDtypeStruct((nb, cp), jnp.float32),
+            jax.ShapeDtypeStruct((nb, cp), jnp.float32),
+        ),
+        in_specs=_row_block_specs(4, block_rows, cp),
+        out_specs=(
+            pl.BlockSpec((1, cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, cp), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(*views)
+    inv_n = 1.0 / (4 * rows)
+    mean = jnp.sum(sums, axis=0, keepdims=True) * inv_n
+    var = jnp.sum(sqs, axis=0, keepdims=True) * inv_n - mean * mean
+    y = pl.pallas_call(
+        functools.partial(_apply_pool_block_kernel, eps=eps, slope=slope),
+        grid=(nb,),
+        out_shape=jax.ShapeDtypeStruct((rp, cp), x0.dtype),
+        in_specs=_row_block_specs(4, block_rows, cp)
+        + [_bcast_spec(cp)] * 4,
+        out_specs=pl.BlockSpec((block_rows, cp), lambda i: (i, 0)),
+        interpret=interpret,
+    )(*views, gp, bp, mean, var)
+    return y[:rows, :cols], mean[0, :cols], var[0, :cols]
 
 
 # ---------------------------------------------------------------------------
-# Public op: (N, C, H, W) fused bn+leaky_relu with custom VJP
+# Layout helpers
 # ---------------------------------------------------------------------------
 
 
@@ -176,6 +535,21 @@ def _to_2d(x: jax.Array) -> jax.Array:
 def _from_2d(x2d: jax.Array, shape) -> jax.Array:
     n, c, h, w = shape
     return jnp.transpose(x2d.reshape(n, h, w, c), (0, 3, 1, 2))
+
+
+def _pool_views(x: jax.Array):
+    """The four strided (N, C, H/2, W/2) views partitioning 2x2/2 windows."""
+    return (
+        x[:, :, 0::2, 0::2],
+        x[:, :, 0::2, 1::2],
+        x[:, :, 1::2, 0::2],
+        x[:, :, 1::2, 1::2],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Public op 1: custom_vjp (one level of reverse AD, Pallas fwd AND bwd)
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -192,6 +566,10 @@ def fused_bn_leaky_relu(x, gamma, beta, eps=1e-5, slope=0.01, interpret=False):
       ``(y (N, C, H, W), batch_mean (C,), batch_var (C,))`` — var biased, as
       used for normalization; callers apply the unbiased correction for
       running stats (see ``ops/norm.batch_norm``).
+
+    Supports ONE level of reverse-mode AD (the backward is a Pallas kernel
+    behind ``custom_vjp``); use ``fused_bn_leaky_relu_ho`` inside
+    reverse-over-reverse programs (module docstring).
     """
     y, mean, var = _fused_fwd_2d(
         _to_2d(x), gamma, beta, eps=eps, slope=slope, interpret=interpret
@@ -218,3 +596,124 @@ def _fused_vjp_bwd(eps, slope, interpret, residuals, cotangents):
 
 
 fused_bn_leaky_relu.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public op 2: custom_jvp (arbitrary-order AD, Pallas fwd + lax tangents)
+# ---------------------------------------------------------------------------
+
+
+def _stat_tangents(x, dx, mean):
+    """fp32 ``(dmean, dvar, xc, dxf)`` of the biased batch stats over NCHW
+    axes (0, 2, 3). ``dvar = 2 E[xc dx]`` since ``E[xc] = 0``."""
+    xf = x.astype(jnp.float32)
+    dxf = dx.astype(jnp.float32)
+    xc = xf - mean[None, :, None, None]
+    dmean = jnp.mean(dxf, axis=(0, 2, 3))
+    dvar = 2.0 * jnp.mean(xc * dxf, axis=(0, 2, 3))
+    return dmean, dvar, xc, dxf
+
+
+def _norm_act_tangent(xc, dxf, gamma, beta, dgamma, dbeta, mean, var, dmean,
+                      dvar, *, eps, slope):
+    """fp32 tangent of ``leaky_relu(xhat * gamma + beta)`` given centered
+    primal ``xc`` and the stat tangents. The LeakyReLU mask comes from the
+    lax-recomputed pre-activation (consistent linearization, ~1 ulp from
+    the kernel's own mask)."""
+    b = lambda a: a.astype(jnp.float32)[None, :, None, None]  # noqa: E731
+    inv = jax.lax.rsqrt(var + eps)
+    dinv = -0.5 * inv * inv * inv * dvar
+    xhat = xc * b(inv)
+    dxhat = (dxf - b(dmean)) * b(inv) + xc * b(dinv)
+    pre = xhat * b(gamma) + b(beta)
+    dpre = dxhat * b(gamma) + xhat * b(dgamma) + b(dbeta)
+    return pre, jnp.where(pre >= 0, dpre, slope * dpre)
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4, 5))
+def fused_bn_leaky_relu_ho(x, gamma, beta, eps=1e-5, slope=0.01,
+                           interpret=False):
+    """Higher-order twin of ``fused_bn_leaky_relu``: same Pallas forward,
+    lax-expressed tangents, differentiable to any order (legal under the
+    reverse-over-reverse MAML/MAML++ train step). Same signature/returns.
+    """
+    y, mean, var = _fused_fwd_2d(
+        _to_2d(x), gamma, beta, eps=eps, slope=slope, interpret=interpret
+    )
+    return _from_2d(y, x.shape), mean, var
+
+
+@fused_bn_leaky_relu_ho.defjvp
+def _fused_ho_jvp(eps, slope, interpret, primals, tangents):
+    x, gamma, beta = primals
+    dx, dgamma, dbeta = tangents
+    # Recursive primal: deeper traces re-enter this rule, so the Pallas call
+    # only ever executes on fully-primal values (module docstring).
+    y, mean, var = fused_bn_leaky_relu_ho(x, gamma, beta, eps, slope, interpret)
+    dmean, dvar, xc, dxf = _stat_tangents(x, dx, mean)
+    _, dy = _norm_act_tangent(
+        xc, dxf, gamma, beta, dgamma, dbeta, mean, var, dmean, dvar,
+        eps=eps, slope=slope,
+    )
+    return (y, mean, var), (dy.astype(y.dtype), dmean, dvar)
+
+
+# ---------------------------------------------------------------------------
+# Public op 3: custom_jvp with the 2x2/2 max-pool epilogue fused in
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4, 5))
+def fused_bn_leaky_relu_pool(x, gamma, beta, eps=1e-5, slope=0.01,
+                             interpret=False):
+    """``max_pool2d(leaky_relu(bn(x) * gamma + beta), 2, 2)`` + batch stats,
+    fused: the kernel consumes the four strided views partitioning the pool
+    windows and writes the pooled ``(N, C, H/2, W/2)`` activation directly.
+
+    Requires even H and W (torch's floor-mode pooling DROPS the trailing
+    row/column at odd sizes, but BN statistics still cover them — callers
+    fall back to the unpooled op + ``max_pool2d`` for odd stages).
+    Arbitrary-order AD like ``fused_bn_leaky_relu_ho``.
+    """
+    n, c, h, w = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            f"fused_bn_leaky_relu_pool needs even H, W (got {h}x{w}); "
+            "use fused_bn_leaky_relu + max_pool2d for odd stages"
+        )
+    views = [_to_2d(v) for v in _pool_views(x)]
+    y2d, mean, var = _fused_pool_fwd_2d(
+        *views, gamma, beta, eps=eps, slope=slope, interpret=interpret
+    )
+    return _from_2d(y2d, (n, c, h // 2, w // 2)), mean, var
+
+
+@fused_bn_leaky_relu_pool.defjvp
+def _fused_pool_jvp(eps, slope, interpret, primals, tangents):
+    x, gamma, beta = primals
+    dx, dgamma, dbeta = tangents
+    yp, mean, var = fused_bn_leaky_relu_pool(
+        x, gamma, beta, eps, slope, interpret
+    )
+    # Statistics (and their tangents) cover the FULL pre-pool activation.
+    dmean, dvar, _xc, _dxf = _stat_tangents(x, dx, mean)
+    # Per-view activations + tangents in lax; argmax selection against the
+    # lax-recomputed max (first winner on exact ties, matching
+    # jnp.maximum's left-biased tangent).
+    ys, dys = [], []
+    for v, dv in zip(_pool_views(x), _pool_views(dx)):
+        xc_v = v.astype(jnp.float32) - mean[None, :, None, None]
+        pre, dpre = _norm_act_tangent(
+            xc_v, dv.astype(jnp.float32), gamma, beta, dgamma, dbeta,
+            mean, var, dmean, dvar, eps=eps, slope=slope,
+        )
+        ys.append(jnp.where(pre >= 0, pre, slope * pre))
+        dys.append(dpre)
+    y_lax = functools.reduce(jnp.maximum, ys)
+    dyp = jnp.zeros_like(dys[0])
+    taken = jnp.zeros(y_lax.shape, bool)
+    for yi, dyi in zip(ys, dys):
+        win = (yi >= y_lax) & ~taken
+        dyp = jnp.where(win, dyi, dyp)
+        taken = taken | win
+    return (yp, mean, var), (dyp.astype(yp.dtype), dmean, dvar)

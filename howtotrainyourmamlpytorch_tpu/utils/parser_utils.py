@@ -77,8 +77,17 @@ def get_parser() -> argparse.ArgumentParser:
     # meta_neural_network_architectures.py:436-539) — TPU-flag extension.
     add("--block_order", type=str, default="conv_norm")
     # Fused Pallas bn+leaky_relu on one-level-AD paths (eval / baselines) —
-    # measured 1.12x eval throughput on TPU v5e (PERF_NOTES.md). TPU flag.
+    # measured 1.28x eval throughput on TPU v5e (PERF_NOTES.md). TPU flag.
     add("--use_pallas_fused_norm", type=str, default="False")
+    # Second-order-capable fused norm on the MAML/MAML++ TRAIN paths (the
+    # reverse-over-reverse meta-gradient; ops/pallas_fused_norm.py
+    # fused_bn_leaky_relu_ho). Independent of --use_pallas_fused_norm so
+    # each consumer path flips only on a measured win. TPU flag.
+    add("--fused_norm_train", type=str, default="False")
+    # Extend the fused boundary through the backbone's 2x2 max pool
+    # (norm -> leaky_relu -> max_pool epilogue) on even-sized stages,
+    # wherever a fused variant is active. TPU flag.
+    add("--fused_norm_pool", type=str, default="False")
     # Episode-synthesis backend: "thread" (GIL-releasing pool, zero IPC) or
     # "process" (reference DataLoader-worker model: forked workers, linear
     # scaling past the GIL). TPU flag.
@@ -243,6 +252,8 @@ def args_to_maml_config(args):
         use_pallas_fused_norm=bool(
             getattr(args, "use_pallas_fused_norm", False)
         ),
+        fused_norm_train=bool(getattr(args, "fused_norm_train", False)),
+        fused_norm_pool=bool(getattr(args, "fused_norm_pool", False)),
         per_step_bn_statistics=bool(args.per_step_bn_statistics),
         num_steps=int(args.number_of_training_steps_per_iter),
         enable_inner_loop_optimizable_bn_params=bool(

@@ -67,9 +67,12 @@ def test_multi_matches_sequential():
         )
 
 
-def test_multi_iter_sharded_mesh():
+def test_multi_iter_sharded_mesh(spmd_compile_guard):
     """run_train_iters under a dp mesh: batches shard over 'dp', result
-    matches the unsharded multi-step run."""
+    matches the unsharded multi-step run. Guarded: some jaxlib builds
+    CHECK-crash XLA's CPU GSPMD partitioner on sharded conv programs
+    (tests/conftest.py spmd_compile_guard), which would abort the whole
+    pytest process here and truncate the suite."""
     from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
 
     cfg = _cfg()

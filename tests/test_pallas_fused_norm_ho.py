@@ -1,0 +1,501 @@
+"""Second-order-capable fused Pallas normalization stack vs the pure-lax
+reference (interpret mode on CPU; the same kernels compile for TPU).
+
+Covers the three new pieces of ``ops/pallas_fused_norm.py``:
+
+* ``fused_bn_leaky_relu_ho`` — the ``custom_jvp`` op that is legal inside
+  reverse-over-reverse programs (the MAML/MAML++ train step): forward,
+  first-order AND second-order gradient parity against lax;
+* the row-blocked two-phase kernel path (large activations that exceed the
+  VMEM budget — e.g. the mini-ImageNet 84x84 stages), forced here by
+  shrinking the budget;
+* ``fused_bn_leaky_relu_pool`` — the norm -> leaky_relu -> 2x2 max-pool
+  epilogue, same parity bar;
+
+plus the train-path gating (``BackboneConfig.fused_norm_train`` /
+``fused_norm_pool``) through the real second-order MAML train program.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.ops import max_pool2d
+from howtotrainyourmamlpytorch_tpu.ops import pallas_fused_norm as pfn
+from howtotrainyourmamlpytorch_tpu.ops.norm import (
+    batch_norm,
+    init_batch_norm_state,
+)
+
+EPS, SLOPE = 1e-5, 0.01
+
+
+def _reference(x, gamma, beta):
+    state = init_batch_norm_state(x.shape[1])
+    out, _ = batch_norm(x, gamma, beta, state, 0, eps=EPS)
+    return jax.nn.leaky_relu(out, negative_slope=SLOPE)
+
+
+def _reference_pool(x, gamma, beta):
+    return max_pool2d(_reference(x, gamma, beta), 2, 2)
+
+
+def _ho(x, gamma, beta):
+    return pfn.fused_bn_leaky_relu_ho(x, gamma, beta, EPS, SLOPE, True)
+
+
+def _pool(x, gamma, beta):
+    return pfn.fused_bn_leaky_relu_pool(x, gamma, beta, EPS, SLOPE, True)
+
+
+def _inputs(rng, shape):
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    gamma = jnp.asarray(rng.rand(shape[1]) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(shape[1]), jnp.float32)
+    return x, gamma, beta
+
+
+@pytest.fixture
+def small_blocks(monkeypatch):
+    """Force the row-blocked two-phase kernel path at CPU-test shapes."""
+    monkeypatch.setattr(pfn, "_MAX_RESIDENT_BYTES", 24 * 128 * 4)
+
+
+# ---------------------------------------------------------------------------
+# fused_bn_leaky_relu_ho
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(10, 64, 14, 14), (3, 5, 4, 4)])
+def test_ho_forward_matches_reference(shape, rng):
+    x, gamma, beta = _inputs(rng, shape)
+    y, mean, var = _ho(x, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_reference(x, gamma, beta)),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(mean), np.asarray(jnp.mean(x, axis=(0, 2, 3))),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(var), np.asarray(jnp.var(x, axis=(0, 2, 3))),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_ho_first_order_gradients_match(rng):
+    shape = (4, 5, 6, 6)
+    x, gamma, beta = _inputs(rng, shape)
+    t = jnp.asarray(rng.randn(*shape), jnp.float32)
+
+    gf = jax.grad(
+        lambda *a: jnp.sum(_ho(*a)[0] * t), argnums=(0, 1, 2)
+    )(x, gamma, beta)
+    gr = jax.grad(
+        lambda *a: jnp.sum(_reference(*a) * t), argnums=(0, 1, 2)
+    )(x, gamma, beta)
+    for a, b, name in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4, err_msg=name
+        )
+
+
+def _rev_over_rev(f, x, gamma, beta):
+    """The MAML-shaped composition: outer grad over a function that itself
+    takes an inner grad (reverse-over-reverse) — exactly what the
+    one-level ``custom_vjp`` op cannot linearize."""
+
+    def outer(x):
+        def inner_loss(g):
+            return jnp.sum(f(x, g, beta)[0] ** 2)
+
+        g1 = gamma - 0.1 * jax.grad(inner_loss)(gamma)
+        return jnp.sum(f(x, g1, beta)[0])
+
+    return jax.grad(outer)(x)
+
+
+def test_ho_second_order_matches_reference(rng):
+    x, gamma, beta = _inputs(rng, (4, 5, 6, 6))
+    ref = lambda x, g, b: (_reference(x, g, b),)  # noqa: E731
+    got = _rev_over_rev(_ho, x, gamma, beta)
+    want = _rev_over_rev(ref, x, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_vjp_op_still_fails_rev_over_rev(rng):
+    """Documents WHY the ho op exists: the one-level custom_vjp kernel pair
+    cannot be linearized a second time. If jax ever learns to do this the
+    gating in models/maml.py can be simplified — this test will say so."""
+    x, gamma, beta = _inputs(rng, (3, 4, 4, 4))
+    vjp_op = lambda x, g, b: pfn.fused_bn_leaky_relu(  # noqa: E731
+        x, g, b, EPS, SLOPE, True
+    )
+    with pytest.raises(Exception):
+        _rev_over_rev(vjp_op, x, gamma, beta)
+
+
+def test_ho_bf16_input_fp32_stats(rng):
+    x = jnp.asarray(rng.randn(6, 8, 5, 5), jnp.bfloat16)
+    gamma = jnp.ones((8,), jnp.float32)
+    beta = jnp.zeros((8,), jnp.float32)
+    y, mean, var = _ho(x, gamma, beta)
+    assert y.dtype == jnp.bfloat16
+    assert mean.dtype == jnp.float32 and var.dtype == jnp.float32
+    ref = _reference(x.astype(jnp.float32), gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
+    )
+
+
+# ---------------------------------------------------------------------------
+# Row-blocked two-phase kernels
+# ---------------------------------------------------------------------------
+
+
+def test_blocked_forward_matches_reference(rng, small_blocks):
+    x, gamma, beta = _inputs(rng, (6, 37, 10, 12))
+    y, mean, var = pfn.fused_bn_leaky_relu(x, gamma, beta, EPS, SLOPE, True)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_reference(x, gamma, beta)),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(mean), np.asarray(jnp.mean(x, axis=(0, 2, 3))),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_blocked_backward_matches_reference(rng, small_blocks):
+    shape = (6, 37, 10, 12)
+    x, gamma, beta = _inputs(rng, shape)
+    t = jnp.asarray(rng.randn(*shape), jnp.float32)
+    fused = lambda *a: pfn.fused_bn_leaky_relu(  # noqa: E731
+        *a, EPS, SLOPE, True
+    )
+    gf = jax.grad(
+        lambda *a: jnp.sum(fused(*a)[0] * t), argnums=(0, 1, 2)
+    )(x, gamma, beta)
+    gr = jax.grad(
+        lambda *a: jnp.sum(_reference(*a) * t), argnums=(0, 1, 2)
+    )(x, gamma, beta)
+    for a, b, name in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4, err_msg=name
+        )
+
+
+def test_blocked_kernels_under_vmap(rng, small_blocks):
+    """The north-star shapes hit the blocked (gridded) kernels UNDER the
+    task vmap of the meta-batch — pallas batching must compose with the
+    grid for all three ops (fwd + grad)."""
+    x = jnp.asarray(rng.randn(3, 4, 5, 8, 8), jnp.float32)  # (B, N, C, H, W)
+    gamma = jnp.asarray(rng.rand(5) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(5), jnp.float32)
+    ref = jax.vmap(lambda xi: _reference(xi, gamma, beta))(x)
+    for op in (pfn.fused_bn_leaky_relu, pfn.fused_bn_leaky_relu_ho):
+        f = lambda xi: op(xi, gamma, beta, EPS, SLOPE, True)[0]  # noqa: B023,E731
+        np.testing.assert_allclose(
+            np.asarray(jax.vmap(f)(x)), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+        g = jax.grad(lambda xx: jnp.sum(jax.vmap(f)(xx) ** 2))(x)
+        gr = jax.grad(
+            lambda xx: jnp.sum(
+                jax.vmap(lambda xi: _reference(xi, gamma, beta))(xx) ** 2
+            )
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(gr), rtol=1e-4, atol=1e-4
+        )
+    fp = lambda xi: _pool(xi, gamma, beta)[0]  # noqa: E731
+    refp = jax.vmap(lambda xi: _reference_pool(xi, gamma, beta))(x)
+    np.testing.assert_allclose(
+        np.asarray(jax.vmap(fp)(x)), np.asarray(refp), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_blocked_ho_second_order(rng, small_blocks):
+    x, gamma, beta = _inputs(rng, (4, 5, 8, 8))
+    ref = lambda x, g, b: (_reference(x, g, b),)  # noqa: E731
+    got = _rev_over_rev(_ho, x, gamma, beta)
+    want = _rev_over_rev(ref, x, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused_bn_leaky_relu_pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("blocked", [False, True])
+def test_pool_forward_matches_reference(rng, blocked, monkeypatch):
+    if blocked:
+        monkeypatch.setattr(pfn, "_MAX_RESIDENT_BYTES", 24 * 128 * 4)
+    x, gamma, beta = _inputs(rng, (4, 5, 8, 6))
+    y, mean, var = _pool(x, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_reference_pool(x, gamma, beta)),
+        rtol=1e-5, atol=1e-5,
+    )
+    # Statistics cover the FULL pre-pool activation.
+    np.testing.assert_allclose(
+        np.asarray(mean), np.asarray(jnp.mean(x, axis=(0, 2, 3))),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(var), np.asarray(jnp.var(x, axis=(0, 2, 3))),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_pool_gradients_match_reference(rng):
+    shape = (4, 5, 8, 6)
+    x, gamma, beta = _inputs(rng, shape)
+    t = jnp.asarray(rng.randn(shape[0], shape[1], 4, 3), jnp.float32)
+    gf = jax.grad(
+        lambda *a: jnp.sum(_pool(*a)[0] * t), argnums=(0, 1, 2)
+    )(x, gamma, beta)
+    gr = jax.grad(
+        lambda *a: jnp.sum(_reference_pool(*a) * t), argnums=(0, 1, 2)
+    )(x, gamma, beta)
+    for a, b, name in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4, err_msg=name
+        )
+
+
+def test_pool_second_order_matches_reference(rng):
+    x, gamma, beta = _inputs(rng, (3, 4, 6, 6))
+    ref = lambda x, g, b: (_reference_pool(x, g, b),)  # noqa: E731
+    got = _rev_over_rev(_pool, x, gamma, beta)
+    want = _rev_over_rev(ref, x, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_pool_rejects_odd_spatial(rng):
+    x, gamma, beta = _inputs(rng, (2, 4, 7, 6))
+    with pytest.raises(ValueError, match="even"):
+        _pool(x, gamma, beta)
+
+
+# ---------------------------------------------------------------------------
+# Train-path gating through the real MAML program
+# ---------------------------------------------------------------------------
+
+
+def _make_maml(fused_train=False, fused_pool=False, max_pooling=False):
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig,
+        MAMLConfig,
+        MAMLFewShotLearner,
+    )
+
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2, num_filters=4, per_step_bn_statistics=True,
+            num_steps=2, num_classes=5, image_height=8, image_width=8,
+            max_pooling=max_pooling,
+            fused_norm_train=fused_train, fused_norm_pool=fused_pool,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=True,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    return learner, learner.init_state(jax.random.PRNGKey(5))
+
+
+def _episode_batch(rng):
+    xs = rng.rand(2, 5, 1, 8, 8).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :], (2, 1))
+    return (xs, xs.copy(), ys, ys.copy())
+
+
+def _meta_value_and_grad(learner, state, batch, second_order=True):
+    outer = {"theta": state.theta, "lslr": state.lslr}
+    batch = tuple(jnp.asarray(b) for b in batch)
+    importance = jnp.full((2,), 0.5, jnp.float32)
+    return jax.value_and_grad(learner._meta_loss, has_aux=True)(
+        outer, state.bn_state, batch, importance, 2, second_order
+    )
+
+
+@pytest.mark.parametrize("max_pooling", [False, True])
+@pytest.mark.parametrize("fused_pool", [False, True])
+def test_fused_train_second_order_meta_grad_matches_lax(
+    rng, max_pooling, fused_pool
+):
+    """The acceptance bar: lax-vs-Pallas SECOND-order meta-gradient parity
+    through the full train program (vmap over tasks, scan over inner steps,
+    remat, inner value_and_grad) with the fused train path enabled."""
+    batch = _episode_batch(rng)
+    la, sa = _make_maml(False, False, max_pooling)
+    lb, sb = _make_maml(True, fused_pool, max_pooling)
+    (loss_a, _), grads_a = _meta_value_and_grad(la, sa, batch)
+    (loss_b, _), grads_b = _meta_value_and_grad(lb, sb, batch)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads_a), jax.tree.leaves(grads_b)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+
+
+def test_fused_train_first_order_runs_and_matches(rng):
+    """First-order MAML still differentiates the inner value_and_grad via
+    the carry (reverse-over-reverse in structure) — the ho op must hold
+    there too."""
+    batch = _episode_batch(rng)
+    la, sa = _make_maml(False)
+    lb, sb = _make_maml(True)
+    (loss_a, _), grads_a = _meta_value_and_grad(la, sa, batch, second_order=False)
+    (loss_b, _), grads_b = _meta_value_and_grad(lb, sb, batch, second_order=False)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads_a), jax.tree.leaves(grads_b)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+
+
+def test_fused_train_full_train_iter_runs(rng):
+    """End-to-end run_train_iter with the fused train path (jit + donate +
+    optimizer): losses stay tolerance-equal to lax on the first update
+    (after Adam steps the ulp-level kernel/lax noise is sign-amplified, so
+    exact trajectory equality is not the contract — gradient parity above
+    is)."""
+    batch = _episode_batch(rng)
+    la, sa = _make_maml(False, False, True)
+    lb, sb = _make_maml(True, True, True)
+    sa, ma = la.run_train_iter(sa, batch, epoch=20)
+    sb, mb = lb.run_train_iter(sb, batch, epoch=20)
+    np.testing.assert_allclose(
+        float(ma["loss"]), float(mb["loss"]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(ma["accuracy"]), float(mb["accuracy"]), rtol=0, atol=1e-6
+    )
+
+
+def test_eval_knob_stays_independent(rng):
+    """fused_norm_train alone must not change the eval path program choice
+    (eval is gated by use_pallas_fused_norm; VERDICT-measured 1.28x there
+    vs unmeasured jvp) — eval results match lax exactly in program terms."""
+    batch = _episode_batch(rng)
+    la, sa = _make_maml(False)
+    lb, sb = _make_maml(True)
+    _, ma, logits_a = la.run_validation_iter(sa, batch)
+    _, mb, logits_b = lb.run_validation_iter(sb, batch)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b))
+
+
+def test_resnet_fused_train_runs(rng):
+    """The shared fused_norm_act also serves ResNet-12; the jvp variant must
+    run under the second-order train step there."""
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig,
+        MAMLConfig,
+        MAMLFewShotLearner,
+    )
+
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            architecture="resnet12", num_stages=4, num_filters=4,
+            per_step_bn_statistics=True, num_steps=2, num_classes=5,
+            image_height=16, image_width=16, fused_norm_train=True,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=True,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    xs = rng.rand(2, 5, 1, 16, 16).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :], (2, 1))
+    state, losses = learner.run_train_iter(
+        state, (xs, xs.copy(), ys, ys.copy()), epoch=20
+    )
+    assert np.isfinite(float(losses["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# Config surface + log cadence satellites
+# ---------------------------------------------------------------------------
+
+
+def test_fused_train_flags_parse_and_wire(tmp_path, monkeypatch):
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+        args_to_maml_config,
+        get_args,
+    )
+
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    args, _ = get_args(
+        ["--fused_norm_train", "True", "--fused_norm_pool", "True"]
+    )
+    assert args.fused_norm_train is True
+    assert args.fused_norm_pool is True
+    cfg = args_to_maml_config(args)
+    assert cfg.backbone.fused_norm_train is True
+    assert cfg.backbone.fused_norm_pool is True
+
+    args, _ = get_args([])
+    assert args.fused_norm_train is False
+    assert args.fused_norm_pool is False
+    cfg = args_to_maml_config(args)
+    assert cfg.backbone.fused_norm_train is False
+    assert cfg.backbone.fused_norm_pool is False
+
+
+def test_resolve_fused_variant():
+    from howtotrainyourmamlpytorch_tpu.models.backbone import (
+        BackboneConfig,
+        resolve_fused_variant,
+    )
+
+    cfg = BackboneConfig()
+    assert resolve_fused_variant(cfg, None) == "off"
+    assert resolve_fused_variant(cfg, True) == "vjp"
+    assert resolve_fused_variant(cfg, False) == "off"
+    assert resolve_fused_variant(cfg, "jvp") == "jvp"
+    cfg_eval = dataclasses.replace(cfg, use_pallas_fused_norm=True)
+    assert resolve_fused_variant(cfg_eval, None) == "vjp"
+    cfg_train = dataclasses.replace(cfg, fused_norm_train=True)
+    assert resolve_fused_variant(cfg_train, None) == "jvp"
+    with pytest.raises(ValueError):
+        resolve_fused_variant(cfg, "sideways")
+
+
+@pytest.mark.parametrize("chunk", [5, 25, 50, 125])
+def test_multi_dispatch_log_cadence_matches_k1(chunk):
+    """VERDICT r3 weak #5: the K>1 dispatch path logged at half the K=1
+    cadence (`% 100` vs `% 50`). The shared predicate now yields the same
+    number of log lines per 500-iter epoch regardless of K (one extra is
+    tolerated when K doesn't divide the cadence boundary exactly)."""
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        TRAIN_LOG_EVERY,
+        _multi_log_due,
+    )
+
+    total = 500
+    k1_prints = sum(
+        1 for i in range(1, total + 1) if i % TRAIN_LOG_EVERY == 0 or i == 1
+    )
+    multi_prints = sum(
+        1
+        for i in range(chunk, total + 1, chunk)
+        if _multi_log_due(i, chunk)
+    )
+    # A dispatch can log at most once, so huge K caps at one line per
+    # dispatch; otherwise cadence must match K=1 (±1 for boundary phase).
+    expected = min(k1_prints, total // chunk)
+    assert abs(multi_prints - expected) <= 1, (chunk, multi_prints, expected)

@@ -192,7 +192,7 @@ def test_mp_sharding_rules_cover_resnet_tree():
     assert shardings["linear"]["weight"].spec == P(None, "mp")
 
 
-def test_dp_sharded_train_iter_runs(rng):
+def test_dp_sharded_train_iter_runs(rng, spmd_compile_guard):
     from howtotrainyourmamlpytorch_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(jax.devices()[:2], data_parallel=2, model_parallel=1)

@@ -68,7 +68,7 @@ def _meta_grads(learner, state, prepared, importance):
     return loss, grads
 
 
-def test_dp_meta_grads_match_unsharded(rng):
+def test_dp_meta_grads_match_unsharded(rng, spmd_compile_guard):
     batch = _batch(rng)
     learner = MAMLFewShotLearner(_cfg())
     state = learner.init_state(jax.random.PRNGKey(3))
@@ -94,7 +94,7 @@ def test_dp_meta_grads_match_unsharded(rng):
                                    rtol=1e-3, atol=2e-5)
 
 
-def test_dp_train_iter_runs_sharded(rng):
+def test_dp_train_iter_runs_sharded(rng, spmd_compile_guard):
     """The learner's own mesh path (in_shardings pinned) trains to finite
     loss with the task axis over 8 devices."""
     batch = _batch(rng)
@@ -135,7 +135,7 @@ def test_mp_backbone_forward_matches_replicated(rng):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_mp_train_step_matches_replicated(rng):
+def test_mp_train_step_matches_replicated(rng, spmd_compile_guard):
     """A full second-order MAML train step with theta laid out over the
     ``mp`` axis (dp x mp = 2 x 2) produces the replicated step's results.
     Uses the inner-gradient anchor (mp_grad_anchor) the learner installs

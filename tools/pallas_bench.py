@@ -1,14 +1,23 @@
-"""Measures the Pallas fused bn+leaky_relu kernel on its remaining consumers
-(VERDICT r2 weak #5 / next #10): the MAML++ eval path (the 1.12x number from
-r2), the ensemble-test-eval shape (600 tasks / batch 8), and the GD and
-matching-nets TRAINING paths (single outer grad — the one-level-AD regime
-the kernel supports).
+"""Measures the Pallas fused bn+leaky_relu kernel stack on every consumer
+path (VERDICT r2 weak #5 / r3 next #10, extended for the second-order train
+stack):
 
-Usage: python tools/pallas_bench.py   (quiet chip; prints one line per case)
+* the MAML++ eval path (custom_vjp kernel pair — the 1.28x r3 number) and
+  the GD / matching-nets TRAINING paths (single outer grad, same op);
+* the MAML++ TRAIN path — second order, reverse-over-reverse — through the
+  second-order-capable ``fused_bn_leaky_relu_ho`` op
+  (``--fused_norm_train``), at both the flagship Omniglot shapes and the
+  mini-ImageNet north-star shapes (84x84x3, 48 filters, max-pool blocks,
+  batch 2, 5-shot/15-target), with and without the fused max-pool epilogue
+  (``--fused_norm_pool``).
+
+Usage: python tools/pallas_bench.py [--skip-imagenet]
+(quiet chip; prints one line per case plus speedup summaries)
 """
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import os
 import sys
@@ -32,7 +41,36 @@ def _timed(step, drain, budget_s=6.0):
     return n / (time.perf_counter() - t0)
 
 
+def _with_backbone(cfg, **kwargs):
+    return dataclasses.replace(
+        cfg, backbone=dataclasses.replace(cfg.backbone, **kwargs)
+    )
+
+
+def _measure_train(results, key, cfg, batch, budget_s=6.0):
+    """Second-order K=1 train-step rate for one config variant."""
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+
+    learner = MAMLFewShotLearner(cfg)
+    box = [learner.init_state(jax.random.PRNGKey(3))]
+
+    def step():
+        # epoch 20: steady state — second order, past the MSL horizon.
+        box[0], _ = learner.run_train_iter(box[0], batch, epoch=20)
+
+    results[key] = _timed(
+        step, lambda: jax.block_until_ready(box[0].theta), budget_s
+    )
+
+
 def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--skip-imagenet", action="store_true",
+        help="skip the (slow) mini-ImageNet-shape train cases",
+    )
+    args = parser.parse_args()
+
     from __graft_entry__ import _episode_batch, _flagship_config
     from howtotrainyourmamlpytorch_tpu.models import (
         GradientDescentLearner,
@@ -42,16 +80,15 @@ def main() -> None:
     from howtotrainyourmamlpytorch_tpu.models.common import WireCodec
 
     results = {}
+
+    # ------------------------------------------------------------------
+    # One-level-AD consumers (custom_vjp kernel pair): eval + baselines
+    # ------------------------------------------------------------------
     for fused in (False, True):
         cfg = dataclasses.replace(
             _flagship_config(), wire_codec=WireCodec(1.0, None, None)
         )
-        cfg = dataclasses.replace(
-            cfg,
-            backbone=dataclasses.replace(
-                cfg.backbone, use_pallas_fused_norm=fused
-            ),
-        )
+        cfg = _with_backbone(cfg, use_pallas_fused_norm=fused)
         rng = np.random.RandomState(0)
         batch = _episode_batch(8, cfg, rng)
 
@@ -94,12 +131,60 @@ def main() -> None:
         )
         results[f"mn_train_fused={fused}"] = rate
 
+    # ------------------------------------------------------------------
+    # Second-order MAML TRAIN path (custom_jvp ho op): flagship shapes
+    # ------------------------------------------------------------------
+    base = dataclasses.replace(
+        _flagship_config(), wire_codec=WireCodec(1.0, None, None)
+    )
+    rng = np.random.RandomState(1)
+    batch = _episode_batch(8, base, rng)
+    _measure_train(results, "maml_train2_fused=off", base, batch)
+    _measure_train(
+        results, "maml_train2_fused=jvp",
+        _with_backbone(base, fused_norm_train=True), batch,
+    )
+
+    # ------------------------------------------------------------------
+    # Second-order MAML TRAIN path: mini-ImageNet north-star shapes
+    # (the ~3.8% MFU regime the fused train stack targets — PERF_NOTES.md)
+    # ------------------------------------------------------------------
+    if not args.skip_imagenet:
+        from bench import _imagenet_shape_config
+
+        im = dataclasses.replace(
+            _imagenet_shape_config(), wire_codec=WireCodec(255.0, None, None)
+        )
+        rng = np.random.RandomState(2)
+        im_batch = _episode_batch(2, im, rng, shots=5, targets_per_class=15)
+        _measure_train(
+            results, "imagenet_train2_fused=off", im, im_batch, budget_s=20.0
+        )
+        _measure_train(
+            results, "imagenet_train2_fused=jvp",
+            _with_backbone(im, fused_norm_train=True), im_batch,
+            budget_s=20.0,
+        )
+        _measure_train(
+            results, "imagenet_train2_fused=jvp+pool",
+            _with_backbone(im, fused_norm_train=True, fused_norm_pool=True),
+            im_batch, budget_s=20.0,
+        )
+
     for key, rate in results.items():
         print(f"{key}: {rate:.1f} iters/s")
     for name in ("maml_eval", "gd_train", "mn_train"):
         off = results[f"{name}_fused=False"]
         on = results[f"{name}_fused=True"]
         print(f"{name} fused speedup: {on / off:.3f}x")
+    for name in ("maml_train2", "imagenet_train2"):
+        if f"{name}_fused=off" not in results:
+            continue
+        off = results[f"{name}_fused=off"]
+        for variant in ("jvp", "jvp+pool"):
+            if f"{name}_fused={variant}" in results:
+                on = results[f"{name}_fused={variant}"]
+                print(f"{name} fused[{variant}] speedup: {on / off:.3f}x")
 
 
 if __name__ == "__main__":

@@ -56,6 +56,14 @@ def main() -> None:
     parser.add_argument("--no-remat", action="store_true",
                         help="disable per-inner-step rematerialization "
                              "(trades HBM for fewer recomputed forwards)")
+    parser.add_argument("--fused-train", action="store_true",
+                        help="enable the second-order-capable fused Pallas "
+                             "norm on the train path (fused_norm_train; "
+                             "ops/pallas_fused_norm.fused_bn_leaky_relu_ho)")
+    parser.add_argument("--fused-pool", action="store_true",
+                        help="also fuse the 2x2 max-pool epilogue into the "
+                             "norm kernel on even-sized stages "
+                             "(fused_norm_pool; implies a fused variant)")
     args = parser.parse_args()
 
     import dataclasses
@@ -83,6 +91,15 @@ def main() -> None:
         cfg = dataclasses.replace(cfg, compute_dtype=args.compute_dtype)
     if args.no_remat:
         cfg = dataclasses.replace(cfg, remat_inner_steps=False)
+    if args.fused_train or args.fused_pool:
+        cfg = dataclasses.replace(
+            cfg,
+            backbone=dataclasses.replace(
+                cfg.backbone,
+                fused_norm_train=True,
+                fused_norm_pool=args.fused_pool,
+            ),
+        )
     if args.conv_layout:
         from howtotrainyourmamlpytorch_tpu.ops import conv as conv_ops
 
