@@ -10,12 +10,29 @@ the LR host-side from the epoch and injecting it into an
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+
+def named_partial(name: str, fn, *args, **kwargs):
+    """``functools.partial`` with a ``__name__`` for the XLA program.
+
+    ``jax.jit`` names compiled programs from ``fn.__name__``; bare
+    ``partial`` objects have none, so every jitted step showed up as
+    ``<unnamed wrapped function>`` in ``jax.log_compiles`` output and
+    profiler traces — which blinds the recompile guard
+    (``utils/sanitize.compile_guard``) and makes trace timelines
+    unattributable.
+    """
+    bound = functools.partial(fn, *args, **kwargs)
+    bound.__name__ = name
+    bound.__qualname__ = name
+    return bound
 
 
 def cosine_epoch_lr(

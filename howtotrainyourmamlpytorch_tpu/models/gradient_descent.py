@@ -40,6 +40,7 @@ from .common import (
     cosine_epoch_lr,
     decode_images,
     make_injected_adam,
+    named_partial,
     prepare_batch,
     set_injected_lr,
 )
@@ -69,11 +70,17 @@ class GradientDescentLearner(CheckpointableLearner):
         self.tx = make_injected_adam(cfg.meta_learning_rate, cfg.clip_grad_value)
 
         self._train_step = jax.jit(
-            functools.partial(self._run_batch, num_steps=cfg.number_of_training_steps_per_iter),
+            named_partial(
+                "gd_train_step", self._run_batch,
+                num_steps=cfg.number_of_training_steps_per_iter,
+            ),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
-            functools.partial(self._run_batch, num_steps=cfg.number_of_evaluation_steps_per_iter),
+            named_partial(
+                "gd_eval_step", self._run_batch,
+                num_steps=cfg.number_of_evaluation_steps_per_iter,
+            ),
             donate_argnums=(0,),
         )
 

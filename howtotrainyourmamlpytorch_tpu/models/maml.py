@@ -51,6 +51,7 @@ from .common import (
     WireCodec,
     cosine_epoch_lr,
     decode_images,
+    named_partial,
     prepare_batch,
     set_injected_lr,
 )
@@ -231,7 +232,8 @@ class MAMLFewShotLearner(CheckpointableLearner):
         key = (second_order, final_only)
         if key not in self._train_steps:
             self._train_steps[key] = jax.jit(
-                functools.partial(
+                named_partial(
+                    "_train_step",
                     self._train_step,
                     second_order=second_order,
                     final_only=final_only,
@@ -244,7 +246,11 @@ class MAMLFewShotLearner(CheckpointableLearner):
     def _get_eval_step(self, final_only: bool):
         if final_only not in self._eval_steps:
             self._eval_steps[final_only] = jax.jit(
-                functools.partial(self._evaluation_step, final_only=final_only),
+                named_partial(
+                    "_evaluation_step",
+                    self._evaluation_step,
+                    final_only=final_only,
+                ),
                 **self._jit_kwargs,
             )
         return self._eval_steps[final_only]

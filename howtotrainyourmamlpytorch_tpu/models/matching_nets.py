@@ -49,6 +49,7 @@ from .common import (
     cosine_epoch_lr,
     decode_images,
     make_injected_adam,
+    named_partial,
     prepare_batch,
     set_injected_lr,
 )
@@ -98,11 +99,11 @@ class MatchingNetsLearner(CheckpointableLearner):
         self.tx = make_injected_adam(cfg.meta_learning_rate, cfg.clip_grad_value)
 
         self._train_step = jax.jit(
-            lambda state, batch: self._run_batch(state, batch, training=True),
+            named_partial("matching_train_step", self._run_batch, training=True),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
-            lambda state, batch: self._run_batch(state, batch, training=False)
+            named_partial("matching_eval_step", self._run_batch, training=False)
         )
 
     def init_state(self, key: jax.Array) -> MatchingNetsState:

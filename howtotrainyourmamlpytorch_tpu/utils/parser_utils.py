@@ -15,6 +15,14 @@ Capability parity with ``utils/parser_utils.py`` (reference ``:4-106``):
 Device pick is TPU-native: the returned ``device`` is the first JAX device
 (TPU if present, else CPU) instead of the reference's CUDA probe
 (``:76-88``).
+
+DOCUMENTED DIVERGENCE: five reference flags that nothing (reference or
+port) ever reads are deleted rather than carried — ``reset_stored_paths``,
+``dropout_rate_value``, ``meta_opt_bn``, ``cnn_num_blocks``,
+``cnn_blocks_per_stage`` (graftlint's ``dead-flag`` rule enforces the
+parser stays read-or-removed). Configs carrying those keys still run
+unchanged: the JSON merge copies unknown keys into ``args`` regardless of
+the parser surface.
 """
 
 from __future__ import annotations
@@ -55,18 +63,15 @@ def get_parser() -> argparse.ArgumentParser:
     add("--max_models_to_save", nargs="?", type=int, default=5)
     add("--dataset_name", type=str, default="omniglot_dataset")
     add("--dataset_path", type=str, default="datasets/omniglot_dataset")
-    add("--reset_stored_paths", type=str, default="False")
     add("--experiment_name", nargs="?", type=str)
     add("--architecture_name", nargs="?", type=str)
     add("--continue_from_epoch", nargs="?", type=str, default="latest")
-    add("--dropout_rate_value", type=float, default=0.3)
     add("--num_target_samples", type=int, default=15)
     add("--second_order", type=str, default="False")
     add("--total_epochs", type=int, default=200)
     add("--total_iter_per_epoch", type=int, default=500)
     add("--min_learning_rate", type=float, default=0.00001)
     add("--meta_learning_rate", type=float, default=0.001)
-    add("--meta_opt_bn", type=str, default="False")
     # Sentinel default (None, resolved to the reference's 0.1 later) so an
     # EXPLICIT --task_learning_rate 0.1 is distinguishable from the unset
     # default and wins over a config's init_inner_loop_learning_rate
@@ -95,11 +100,9 @@ def get_parser() -> argparse.ArgumentParser:
     add("--max_pooling", type=str, default="False")
     add("--per_step_bn_statistics", type=str, default="False")
     add("--num_classes_per_set", type=int, default=20)
-    add("--cnn_num_blocks", type=int, default=4)
     add("--number_of_training_steps_per_iter", type=int, default=1)
     add("--number_of_evaluation_steps_per_iter", type=int, default=1)
     add("--cnn_num_filters", type=int, default=64)
-    add("--cnn_blocks_per_stage", type=int, default=1)
     add("--num_samples_per_class", type=int, default=1)
     add("--name_of_args_json_file", type=str, default="None")
     # Keys present in configs but absent from the reference parser — they
@@ -145,6 +148,14 @@ def get_parser() -> argparse.ArgumentParser:
         help="when set, jax.profiler-trace the first profile_num_iters "
              "train iterations into this directory")
     add("--profile_num_iters", type=int, default=20)
+    # Trace-time sanitizers (opt-in, process-global jax.config switches;
+    # see utils/sanitize.py and README "Static analysis & sanitizers").
+    add("--debug_nans", type=str, default="False",
+        help="jax_debug_nans: re-run the op that produced a NaN un-jitted "
+             "and raise with its location (slow; debugging only)")
+    add("--check_tracer_leaks", type=str, default="False",
+        help="jax_check_tracer_leaks: raise when a tracer escapes its "
+             "trace (the silent-closure-capture bug class; slow)")
     add("--resnet_widths", nargs="+", type=int, default=None,
         help="4 stage widths for architecture_name=resnet12 (default "
              "cnn_num_filters x 1/2/4/8; MetaOptNet uses 64 160 320 640)")
@@ -192,6 +203,12 @@ def get_args(argv=None):
     # process may have raised it, and the setting is process-global.
     precision = str(getattr(args, "matmul_precision", "default") or "default")
     jax.config.update("jax_default_matmul_precision", precision)
+    # Opt-in trace-time sanitizers. Only flipped ON (never forced off) so a
+    # JAX_DEBUG_NANS=1 environment still works without the flag.
+    if bool(getattr(args, "debug_nans", False)):
+        jax.config.update("jax_debug_nans", True)
+    if bool(getattr(args, "check_tracer_leaks", False)):
+        jax.config.update("jax_check_tracer_leaks", True)
     # Runtime guard covering EVERY launch path (the generated scripts pin
     # this flag, but direct CLI / dispatch invocations may not): 20-way
     # second-order MAML diverges under the TPU default bf16-multiply

@@ -1,0 +1,134 @@
+"""Trace-time sanitizers: the recompile guard.
+
+``graftlint`` (``tools/graftlint``) catches recompile *hazards* statically;
+this module catches recompiles *at runtime*. The guard listens to
+``jax.log_compiles()`` — every XLA compile logs one
+``"Compiling <name> with global shapes and types [...]"`` record on the
+``jax._src.interpreters.pxla`` logger — and indexes the events by jitted
+function name and by *signature* (the logged shapes/dtypes text, which
+includes the leading ``K`` axis of the scan-dispatch path). A steady-state
+training loop must compile each step function exactly once per
+``(shape, dtype, K)`` class; anything more is a silent throughput
+regression (the recompile classes PERF_NOTES.md benches against).
+
+Usage (see the ``compile_guard`` fixture in ``tests/conftest.py``)::
+
+    with compile_guard() as guard:
+        for _ in range(5):
+            state, _ = learner.run_train_iter(state, batch, epoch=0)
+    guard.assert_compiles("_train_step", exactly=1)
+
+The opt-in ``--debug_nans`` / ``--check_tracer_leaks`` sanitizers are wired
+in ``utils/parser_utils.get_args`` (process-global ``jax.config`` switches).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+from dataclasses import dataclass, field
+
+#: The logger jax emits per-compile records on under ``jax.log_compiles()``.
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+
+#: ``Compiling <name> with global shapes and types [<signature>].``
+#: The name may contain spaces (``<unnamed wrapped function>`` for bare
+#: functools.partial objects), so it is everything before the fixed phrase.
+_COMPILE_RE = re.compile(
+    r"Compiling (?P<name>.+?) with global shapes and types "
+    r"(?P<signature>.*?)\.(?:\s|$)"
+)
+
+
+class RecompileError(AssertionError):
+    """A guarded function compiled more often than the declared budget."""
+
+
+@dataclass
+class CompileEvent:
+    name: str
+    signature: str
+
+
+@dataclass
+class CompileGuard:
+    """Collects compile events while active (see :func:`compile_guard`)."""
+
+    events: list[CompileEvent] = field(default_factory=list)
+
+    def _matching(self, name_contains: str) -> list[CompileEvent]:
+        return [e for e in self.events if name_contains in e.name]
+
+    def count(self, name_contains: str) -> int:
+        """Compile events whose jitted-function name contains the needle."""
+        return len(self._matching(name_contains))
+
+    def signatures(self, name_contains: str) -> list[str]:
+        return [e.signature for e in self._matching(name_contains)]
+
+    def assert_compiles(self, name_contains: str, exactly: int) -> None:
+        """The steady-state contract: a fixed input class compiles the step
+        exactly ``exactly`` times (1, for a single-variant run). Trips on
+        BOTH recompile classes — same-signature recompiles (a fresh jit
+        wrapper per call) and signature churn (an argument that should be
+        static, e.g. a config dict whose structure varies per call)."""
+        found = self.count(name_contains)
+        if found != exactly:
+            sigs = "\n  ".join(self.signatures(name_contains)) or "<none>"
+            raise RecompileError(
+                f"expected exactly {exactly} compile(s) of "
+                f"*{name_contains}*, observed {found}; signatures:\n  {sigs}"
+            )
+
+    def assert_unique_signatures(self, name_contains: str) -> None:
+        """No (shape, dtype, K) class may compile twice — catches the
+        fresh-jit-wrapper-per-iteration class even when the signature set
+        itself is legitimate (e.g. multiple K variants in one run)."""
+        seen: dict[str, int] = {}
+        for sig in self.signatures(name_contains):
+            seen[sig] = seen.get(sig, 0) + 1
+        dupes = {s: n for s, n in seen.items() if n > 1}
+        if dupes:
+            detail = "\n  ".join(f"{n}x {s}" for s, n in dupes.items())
+            raise RecompileError(
+                f"*{name_contains}* recompiled for an already-compiled "
+                f"(shape, dtype, K) class:\n  {detail}"
+            )
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, guard: CompileGuard):
+        super().__init__(level=logging.DEBUG)
+        self._guard = guard
+
+    def emit(self, record: logging.LogRecord) -> None:
+        match = _COMPILE_RE.search(record.getMessage())
+        if match:
+            self._guard.events.append(
+                CompileEvent(name=match.group("name"),
+                             signature=match.group("signature"))
+            )
+
+
+@contextlib.contextmanager
+def compile_guard():
+    """Context manager: yields a :class:`CompileGuard` recording every XLA
+    compile in the block. Reentrant-safe; restores logger state on exit."""
+    import jax
+
+    guard = CompileGuard()
+    handler = _CompileLogHandler(guard)
+    logger = logging.getLogger(_COMPILE_LOGGER)
+    old_level = logger.level
+    logger.addHandler(handler)
+    # The handler must see WARNING records even under a quiet root logger;
+    # log_compiles emits at WARNING so DEBUG-level capture is unaffected.
+    if logger.level > logging.WARNING or logger.level == logging.NOTSET:
+        logger.setLevel(logging.WARNING)
+    try:
+        with jax.log_compiles():
+            yield guard
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)

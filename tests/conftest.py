@@ -28,6 +28,25 @@ def rng():
 
 
 # ---------------------------------------------------------------------------
+# Recompile guard (trace-time sanitizer, utils/sanitize.py)
+# ---------------------------------------------------------------------------
+#
+# Usage:   with compile_guard() as guard: <run iterations>
+#          guard.assert_compiles("_train_step", exactly=1)
+# The guard listens to jax.log_compiles() and indexes XLA compiles by jitted
+# function name and by signature (shapes/dtypes, incl. the K scan axis), so
+# tests can pin "this step compiles once per (shape, dtype, K) class" — the
+# regression guard behind every bench key in PERF_NOTES.md.
+
+
+@pytest.fixture
+def compile_guard():
+    from howtotrainyourmamlpytorch_tpu.utils.sanitize import compile_guard
+
+    return compile_guard
+
+
+# ---------------------------------------------------------------------------
 # GSPMD partitioner guard
 # ---------------------------------------------------------------------------
 #

@@ -1,0 +1,451 @@
+"""Unit tests for the graftlint rule set: one positive (rule fires) and one
+negative (rule stays quiet) case per rule, plus the suppression contract.
+
+Violating code lives in source *strings* handed to ``lint_source`` — the
+test file itself must stay clean, since tier-1 lints ``tests/`` too
+(``test_graftlint_clean.py``).
+"""
+
+import textwrap
+
+from tools.graftlint import RULES, lint_source, lint_sources
+
+
+def rules_of(src: str, path: str = "mod.py") -> set:
+    return {v.rule for v in lint_source(textwrap.dedent(src), path)}
+
+
+def violations_of(src: str, path: str = "mod.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def test_rule_registry_has_at_least_eight_rules():
+    assert len(RULES) >= 8
+
+
+# ---------------------------------------------------------------------------
+# prng-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_prng_reuse_positive_double_consume():
+    src = """
+    import jax
+
+    def sample(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert "prng-reuse" in rules_of(src)
+
+
+def test_prng_reuse_positive_consume_in_loop():
+    src = """
+    import jax
+
+    def sample(key, n):
+        out = []
+        for _ in range(n):
+            out.append(jax.random.normal(key, (3,)))
+        return out
+    """
+    assert "prng-reuse" in rules_of(src)
+
+
+def test_prng_reuse_negative_split_between_uses():
+    src = """
+    import jax
+
+    def sample(key):
+        key, sub = jax.random.split(key)
+        a = jax.random.normal(sub, (3,))
+        key, sub = jax.random.split(key)
+        b = jax.random.uniform(sub, (3,))
+        return a + b
+
+    def loop(key, n):
+        out = []
+        for k in jax.random.split(key, n):
+            out.append(jax.random.normal(k, (3,)))
+        return out
+    """
+    assert "prng-reuse" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# host-numpy-in-trace
+# ---------------------------------------------------------------------------
+
+
+def test_host_numpy_positive_np_on_traced_value():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        y = jnp.mean(x)
+        return np.asarray(y) * 2
+    """
+    assert "host-numpy-in-trace" in rules_of(src)
+
+
+def test_host_numpy_negative_np_on_host_constants():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def importance(n):
+        return np.ones(n, np.float32) / n
+
+    @jax.jit
+    def step(x):
+        return jnp.mean(x)
+    """
+    assert "host-numpy-in-trace" not in rules_of(src)
+
+
+def test_host_numpy_negative_treemap_callback_is_not_traced():
+    # jax.tree.map callbacks run host-side eagerly outside a trace —
+    # np inside them is idiomatic (e.g. asserting pytrees in tests).
+    src = """
+    import jax
+    import numpy as np
+
+    def check(before, after):
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b),
+                     before, after)
+    """
+    assert "host-numpy-in-trace" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# tracer-branch
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_branch_positive_if_on_device_value():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        loss = jnp.mean(x)
+        if loss > 0:
+            return loss
+        return -loss
+    """
+    assert "tracer-branch" in rules_of(src)
+
+
+def test_tracer_branch_negative_static_config_branch():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def make(second_order):
+        @jax.jit
+        def step(x):
+            if second_order:
+                return jnp.mean(x)
+            if x.ndim == 2:
+                return jnp.sum(x)
+            return jnp.sum(jax.lax.stop_gradient(x))
+        return step
+    """
+    assert "tracer-branch" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# jit-static-config
+# ---------------------------------------------------------------------------
+
+
+def test_jit_static_config_positive_config_arg_not_static():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def step(x, cfg):
+        return jnp.mean(x) * cfg["scale"]
+
+    compiled = jax.jit(step)
+    """
+    assert "jit-static-config" in rules_of(src)
+
+
+def test_jit_static_config_negative_with_static_argnames():
+    src = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    def step(x, mode):
+        return jnp.mean(x)
+
+    compiled = jax.jit(step, static_argnames=("mode",))
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def step2(x, cfg):
+        return jnp.mean(x)
+
+    bound = jax.jit(functools.partial(step, mode="fast"))
+    """
+    assert "jit-static-config" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# missing-donate
+# ---------------------------------------------------------------------------
+
+
+def test_missing_donate_positive_train_step_without_donation():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def _train_step(state, batch):
+        return state, jnp.mean(batch)
+
+    train_step = jax.jit(_train_step)
+    """
+    assert "missing-donate" in rules_of(src)
+
+
+def test_missing_donate_negative_donated_or_eval():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def _train_step(state, batch):
+        return state, jnp.mean(batch)
+
+    def _evaluation_step(state, batch):
+        return jnp.mean(batch)
+
+    train_step = jax.jit(_train_step, donate_argnums=(0,))
+    eval_step = jax.jit(_evaluation_step)
+    """
+    assert "missing-donate" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# dead-flag
+# ---------------------------------------------------------------------------
+
+_PARSER_SRC = """
+import argparse
+
+def get_parser():
+    parser = argparse.ArgumentParser()
+    add = parser.add_argument
+    add("--batch_size", type=int, default=32)
+    add("--ancient_knob", type=int, default=3)
+    return parser
+"""
+
+_CONSUMER_SRC = """
+def build(args):
+    return args.batch_size * 2
+"""
+
+#: The dead-flag rule requires reads from several distinct modules before
+#: it trusts the scan as complete (partial-scan guard) — give it a
+#: plausible consumer spread.
+_CONSUMER_MODULES = {
+    f"pkg/consumer_{i}.py": _CONSUMER_SRC for i in range(4)
+}
+
+
+def test_dead_flag_positive_unread_flag():
+    violations = lint_sources(
+        {"pkg/utils/parser_utils.py": _PARSER_SRC, **_CONSUMER_MODULES}
+    )
+    dead = [v for v in violations if v.rule == "dead-flag"]
+    assert len(dead) == 1
+    assert "ancient_knob" in dead[0].message
+
+
+def test_dead_flag_negative_flag_read_via_getattr_string():
+    consumer = _CONSUMER_SRC + """
+def build2(args):
+    return getattr(args, "ancient_knob", 3)
+"""
+    violations = lint_sources(
+        {
+            "pkg/utils/parser_utils.py": _PARSER_SRC,
+            "pkg/consumer.py": consumer,
+            **_CONSUMER_MODULES,
+        }
+    )
+    assert not [v for v in violations if v.rule == "dead-flag"]
+
+
+def test_dead_flag_only_fires_on_parser_utils_module():
+    # The same add() calls in a random module are not a flag surface.
+    assert "dead-flag" not in rules_of(_PARSER_SRC, path="pkg/other.py")
+
+
+def test_dead_flag_stays_quiet_on_partial_scans():
+    # "dead" is relative to the scanned set: linting parser_utils.py alone
+    # (or a changed-files subset missing the consumer spread) must not
+    # flood every live flag as dead — the rule requires reads from several
+    # distinct modules before trusting the scan.
+    assert "dead-flag" not in rules_of(_PARSER_SRC, path="pkg/utils/parser_utils.py")
+    violations = lint_sources(
+        {
+            "pkg/utils/parser_utils.py": _PARSER_SRC,
+            "pkg/consumer.py": _CONSUMER_SRC,
+        }
+    )
+    assert not [v for v in violations if v.rule == "dead-flag"]
+
+
+# ---------------------------------------------------------------------------
+# device-op-in-data-path
+# ---------------------------------------------------------------------------
+
+
+def test_device_op_positive_jnp_in_loader():
+    src = """
+    import jax.numpy as jnp
+
+    def collate(episodes):
+        return jnp.stack(episodes)
+    """
+    assert "device-op-in-data-path" in rules_of(src, path="pkg/data/loader.py")
+
+
+def test_device_op_negative_numpy_loader_and_non_data_module():
+    numpy_loader = """
+    import numpy as np
+
+    def collate(episodes):
+        return np.stack(episodes)
+    """
+    assert "device-op-in-data-path" not in rules_of(
+        numpy_loader, path="pkg/data/loader.py"
+    )
+    jax_model = """
+    import jax.numpy as jnp
+
+    def forward(x):
+        return jnp.mean(x)
+    """
+    assert "device-op-in-data-path" not in rules_of(
+        jax_model, path="pkg/models/net.py"
+    )
+
+
+# ---------------------------------------------------------------------------
+# traced-mutation
+# ---------------------------------------------------------------------------
+
+
+def test_traced_mutation_positive_capture_append_and_self_write():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    class Learner:
+        def __init__(self):
+            self.history = []
+            self.step = jax.jit(self._step)
+
+        def _step(self, x):
+            y = jnp.mean(x)
+            self.history.append(y)
+            self.last = y
+            return y
+    """
+    found = rules_of(src)
+    assert "traced-mutation" in found
+
+
+def test_traced_mutation_negative_local_accumulation():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        metrics = {}
+        metrics["loss"] = jnp.mean(x)
+        parts = []
+        parts.append(metrics["loss"])
+        return metrics, parts
+    """
+    assert "traced-mutation" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_VIOLATING_LINE = (
+    "import jax\n"
+    "\n"
+    "def sample(key):\n"
+    "    a = jax.random.normal(key, (3,))\n"
+    "    b = jax.random.uniform(key, (3,)){}\n"
+    "    return a + b\n"
+)
+
+
+def test_suppression_with_reason_silences_the_rule():
+    src = _VIOLATING_LINE.format(
+        "  # graftlint: disable=prng-reuse -- intentional: same-draw test"
+    )
+    assert rules_of(src) == set()
+
+
+def test_suppression_without_reason_is_a_violation():
+    src = _VIOLATING_LINE.format("  # graftlint: disable=prng-reuse")
+    found = rules_of(src)
+    assert "bad-suppression" in found
+
+
+def test_suppression_of_unknown_rule_is_a_violation():
+    src = _VIOLATING_LINE.format(
+        "  # graftlint: disable=no-such-rule -- reason here"
+    )
+    found = rules_of(src)
+    assert "bad-suppression" in found
+    assert "prng-reuse" in found  # the real finding is NOT silenced
+
+
+def test_standalone_suppression_covers_next_line():
+    src = (
+        "import jax\n"
+        "\n"
+        "def sample(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    # graftlint: disable=prng-reuse -- exercising identical draws\n"
+        "    b = jax.random.uniform(key, (3,))\n"
+        "    return a + b\n"
+    )
+    assert rules_of(src) == set()
+
+
+def test_unused_suppression_is_a_violation():
+    # A well-formed disable that silences nothing is stale and must be
+    # reported, so suppressions get cleaned up when the excused code goes.
+    src = (
+        "import jax\n"
+        "\n"
+        "def sample(key):\n"
+        "    # graftlint: disable=prng-reuse -- no longer needed here\n"
+        "    return jax.random.normal(key, (3,))\n"
+    )
+    found = violations_of(src)
+    assert [v.rule for v in found] == ["bad-suppression"]
+    assert "unused suppression" in found[0].message
+
+
+def test_parse_error_is_reported_not_raised():
+    found = violations_of("def broken(:\n    pass\n")
+    assert [v.rule for v in found] == ["parse-error"]

@@ -1,0 +1,100 @@
+"""Tier-1 gate: the whole tree lints clean, forever.
+
+Runs the real CLI (``python -m tools.graftlint``) over the same surface a CI
+step would, so no separate CI config is needed — a new violation anywhere in
+``howtotrainyourmamlpytorch_tpu/``, ``tests/`` or ``tools/`` fails the
+suite. Also pins the CLI contract itself: non-zero exit on violations,
+``--format=github`` annotations, ``--list-rules``.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_TARGETS = ["howtotrainyourmamlpytorch_tpu", "tests", "tools"]
+
+
+def run_cli(*argv: str, cwd: str = REPO) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *argv],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=env,
+        timeout=300,
+    )
+
+
+def test_tree_lints_clean():
+    proc = run_cli(*LINT_TARGETS)
+    assert proc.returncode == 0, (
+        "graftlint found violations in the tree:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "graftlint: clean" in proc.stderr
+
+
+def test_in_process_api_agrees_with_cli():
+    from tools.graftlint import lint_paths
+
+    violations = lint_paths([os.path.join(REPO, t) for t in LINT_TARGETS])
+    assert violations == [], [v.format_text() for v in violations]
+
+
+def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "def sample(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))\n"
+        "    return a + b\n"
+    )
+    proc = run_cli(str(bad))
+    assert proc.returncode == 1
+    assert "prng-reuse" in proc.stdout
+
+    proc_gh = run_cli(str(bad), "--format=github")
+    assert proc_gh.returncode == 1
+    line = proc_gh.stdout.strip().splitlines()[0]
+    assert line.startswith("::error file=")
+    assert "title=graftlint prng-reuse" in line
+
+
+def test_cli_list_rules_names_the_full_set():
+    proc = run_cli("--list-rules")
+    assert proc.returncode == 0
+    listed = {
+        line.split(":", 1)[0] for line in proc.stdout.splitlines() if ":" in line
+    }
+    assert {
+        "prng-reuse",
+        "host-numpy-in-trace",
+        "tracer-branch",
+        "jit-static-config",
+        "missing-donate",
+        "dead-flag",
+        "device-op-in-data-path",
+        "traced-mutation",
+    } <= listed
+    assert len(listed) >= 8
+
+
+def test_cli_select_filters_rules(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "def sample(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))\n"
+        "    return a + b\n"
+    )
+    proc = run_cli(str(bad), "--select", "missing-donate")
+    assert proc.returncode == 0  # the only finding is prng-reuse, filtered out
+    proc_unknown = run_cli(str(bad), "--select", "bogus-rule")
+    assert proc_unknown.returncode == 2

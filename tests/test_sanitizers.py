@@ -1,0 +1,216 @@
+"""Trace-time sanitizer suite: the recompile guard and the debug-config
+flag wiring.
+
+The guard (``utils/sanitize.compile_guard``) must (a) demonstrably TRIP on
+a seeded recompile bug — a config dict threaded as a traced argument whose
+structure varies per call, and a fresh jit wrapper built inside the loop —
+and (b) PASS on the real MAML train steps: the K=1 path and the K>1
+scan-dispatch path each compile exactly once per (shape, dtype, K) class
+across a multi-iteration run. That second property is the regression guard
+behind every ``*_meta_iters_per_s`` bench key in PERF_NOTES.md.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.utils.sanitize import RecompileError
+
+
+def tiny_cfg(**kw):
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        second_order=False,
+        **kw,
+    )
+
+
+def tiny_batch(rng, tasks=2):
+    xs = rng.rand(tasks, 5, 1, 1, 8, 8).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(np.int32)
+    return xs, xs.copy(), ys, ys.copy()
+
+
+# ---------------------------------------------------------------------------
+# The guard trips on seeded recompile bugs
+# ---------------------------------------------------------------------------
+
+
+def test_guard_trips_on_nonstatic_dict_arg(compile_guard):
+    """A config dict whose structure varies per call retraces the step every
+    iteration — the guard must see N compiles where the contract says 1."""
+
+    # graftlint: disable=jit-static-config -- the seeded recompile bug this
+    # test exists to trip the guard on (ISSUE 2 acceptance criterion)
+    @jax.jit
+    def step_with_cfg(x, cfg):
+        return jnp.mean(x) * cfg["scale"]
+
+    x = jnp.ones((4, 4))
+    with compile_guard() as guard:
+        step_with_cfg(x, {"scale": 1.0})
+        step_with_cfg(x, {"scale": 1.0, "extra": 0.0})  # new pytree structure
+        step_with_cfg(x, {"scale": 1.0, "extra": 0.0, "more": 2.0})
+    assert guard.count("step_with_cfg") == 3
+    with pytest.raises(RecompileError):
+        guard.assert_compiles("step_with_cfg", exactly=1)
+
+
+def test_guard_trips_on_fresh_jit_wrapper_per_iteration(compile_guard):
+    """jit-inside-the-loop compiles an identical (shape, dtype) class every
+    iteration — the duplicate-signature assertion must trip."""
+    x = jnp.ones((4, 4))
+    with compile_guard() as guard:
+        for _ in range(3):
+
+            def fresh_step(v):
+                return jnp.mean(v) * 2.0
+
+            jax.jit(fresh_step)(x)
+    assert guard.count("fresh_step") == 3
+    with pytest.raises(RecompileError):
+        guard.assert_unique_signatures("fresh_step")
+
+
+def test_unnamed_partial_is_invisible_to_the_guard(compile_guard):
+    """Why the learners jit named_partial(...) instead of bare partials:
+    jit names the XLA program from __name__, and partial objects have none
+    — the compile log line says '<unnamed wrapped function>', which no
+    name-keyed guard can match."""
+
+    def step(v, scale):
+        return jnp.mean(v) * scale
+
+    with compile_guard() as guard:
+        jax.jit(functools.partial(step, scale=2.0))(jnp.ones((4, 4)))
+    assert guard.count("step") == 0
+    assert guard.count("<unnamed wrapped function>") == 1
+
+
+def test_guard_passes_on_cached_jit(compile_guard):
+    @jax.jit
+    def well_behaved(x):
+        return jnp.mean(x)
+
+    x = jnp.ones((4, 4))
+    with compile_guard() as guard:
+        for _ in range(4):
+            well_behaved(x)
+    guard.assert_compiles("well_behaved", exactly=1)
+    guard.assert_unique_signatures("well_behaved")
+
+
+# ---------------------------------------------------------------------------
+# The guard passes on the real train steps (K=1 and K=25 scan dispatch)
+# ---------------------------------------------------------------------------
+
+
+def test_k1_train_step_compiles_once(compile_guard, rng):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    batch = tiny_batch(rng)
+    with compile_guard() as guard:
+        for _ in range(4):
+            state, _ = learner.run_train_iter(state, batch, epoch=0)
+        jax.block_until_ready(state.theta)
+    guard.assert_compiles("_train_step", exactly=1)
+    guard.assert_unique_signatures("_train_step")
+
+
+def test_k25_multi_train_step_compiles_once(compile_guard, rng):
+    """The K=25 scan-dispatch path: several dispatches at a fixed
+    (shape, dtype, K) class must reuse one compiled program."""
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    batches = [tiny_batch(rng) for _ in range(25)]
+    with compile_guard() as guard:
+        for _ in range(3):
+            state, _ = learner.run_train_iters(state, batches, epoch=0)
+        jax.block_until_ready(state.theta)
+    guard.assert_compiles("multi", exactly=1)
+    guard.assert_unique_signatures("multi")
+
+
+def test_k_change_is_a_new_compile_class_not_a_violation(compile_guard, rng):
+    """Two K values are two legitimate (shape, dtype, K) classes: two
+    compiles, but no duplicated signature."""
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    with compile_guard() as guard:
+        state, _ = learner.run_train_iters(
+            state, [tiny_batch(rng) for _ in range(5)], epoch=0
+        )
+        state, _ = learner.run_train_iters(
+            state, [tiny_batch(rng) for _ in range(3)], epoch=0
+        )
+        jax.block_until_ready(state.theta)
+    assert guard.count("multi") == 2
+    guard.assert_unique_signatures("multi")
+
+
+# ---------------------------------------------------------------------------
+# Debug-config wiring (--debug_nans / --check_tracer_leaks)
+# ---------------------------------------------------------------------------
+
+
+def _get_args(argv):
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import get_args
+
+    return get_args(argv)
+
+
+@pytest.fixture
+def restore_debug_config():
+    old_nans = jax.config.jax_debug_nans
+    old_leaks = jax.config.jax_check_tracer_leaks
+    yield
+    jax.config.update("jax_debug_nans", old_nans)
+    jax.config.update("jax_check_tracer_leaks", old_leaks)
+
+
+def test_debug_flags_default_off(restore_debug_config, monkeypatch):
+    monkeypatch.setenv("DATASET_DIR", "/tmp")
+    jax.config.update("jax_debug_nans", False)
+    jax.config.update("jax_check_tracer_leaks", False)
+    args, _ = _get_args([])
+    assert args.debug_nans is False
+    assert args.check_tracer_leaks is False
+    assert jax.config.jax_debug_nans is False
+    assert jax.config.jax_check_tracer_leaks is False
+
+
+def test_debug_flags_opt_in_flip_jax_config(restore_debug_config, monkeypatch):
+    monkeypatch.setenv("DATASET_DIR", "/tmp")
+    args, _ = _get_args(["--debug_nans", "True", "--check_tracer_leaks", "True"])
+    assert args.debug_nans is True
+    assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_check_tracer_leaks is True
+
+
+def test_debug_nans_actually_raises_on_nan(restore_debug_config):
+    jax.config.update("jax_debug_nans", True)
+
+    @jax.jit
+    def bad(x):
+        return jnp.log(x - 1.0)
+
+    with pytest.raises(FloatingPointError):
+        jax.block_until_ready(bad(jnp.zeros(())))

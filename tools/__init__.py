@@ -1,0 +1,1 @@
+"""Developer tooling: benchmarks, profilers, leak probes, and graftlint."""

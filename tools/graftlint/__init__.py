@@ -1,0 +1,33 @@
+"""graftlint — JAX-aware static analysis for this codebase.
+
+An AST-based lint framework targeting the silent-failure classes that
+golden-run archaeology kept finding by accident (PR 1's GSPMD truncation,
+the reference's dropped second-order terms): reused PRNG keys, host-numpy
+on tracers, Python control flow on traced values, recompile hazards,
+missing donation on train steps, dead CLI flags, device ops in the host
+data path, and state mutation inside traced functions.
+
+Run the CLI::
+
+    python -m tools.graftlint howtotrainyourmamlpytorch_tpu/ tests/ tools/
+
+Suppress a finding inline (the reason is mandatory — an unreasoned
+suppression is itself a violation)::
+
+    some_code()  # graftlint: disable=<rule-id> -- why this is safe
+
+Library API: :func:`lint_paths`, :func:`lint_sources`, :func:`lint_source`
+return :class:`Violation` lists; ``RULES`` maps rule id -> rule object.
+``tests/test_graftlint_clean.py`` runs the CLI over the whole tree in
+tier-1, so the package lints clean by construction.
+"""
+
+from .engine import (  # noqa: F401
+    RULES,
+    Violation,
+    lint_paths,
+    lint_source,
+    lint_sources,
+)
+
+__all__ = ["RULES", "Violation", "lint_paths", "lint_source", "lint_sources"]

@@ -1,0 +1,71 @@
+"""CLI: ``python -m tools.graftlint [paths...] [--format=text|github]``.
+
+Exits non-zero when any violation is found, so the tier-1 gate
+(``tests/test_graftlint_clean.py``) and any CI step can invoke it directly.
+``--format=github`` emits GitHub Actions ``::error`` annotations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .engine import RULES, lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static analysis for this codebase",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["howtotrainyourmamlpytorch_tpu", "tests", "tools"],
+        help="files or directories to lint (default: the tier-1 surface)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="output style: human text or GitHub Actions annotations",
+    )
+    parser.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule set and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}: {RULES[rule_id].summary}")
+        return 0
+
+    violations = lint_paths(args.paths)
+    if args.select:
+        selected = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = selected - set(RULES) - {"bad-suppression", "parse-error"}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        violations = [v for v in violations if v.rule in selected]
+
+    for v in violations:
+        print(v.format_github() if args.format == "github" else v.format_text())
+    if violations:
+        print(
+            f"\ngraftlint: {len(violations)} violation(s) in "
+            f"{len({v.path for v in violations})} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("graftlint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

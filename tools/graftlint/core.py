@@ -1,0 +1,189 @@
+"""Core datatypes: violations, parsed modules, the project container, and
+inline-suppression parsing."""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from .tracing import TraceInfo, analyze_tracing, build_alias_map
+
+#: Inline suppression comment syntax: hash, "graftlint:", "disable=" with a
+#: comma-separated rule list, then " -- " and a mandatory reason (an
+#: unreasoned or unknown-rule suppression is reported as bad-suppression).
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*?))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format_text(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def format_github(self) -> str:
+        return (
+            f"::error file={self.path},line={self.line},col={self.col},"
+            f"title=graftlint {self.rule}::{self.message}"
+        )
+
+
+@dataclass
+class Suppression:
+    line: int  # line the comment sits on
+    rules: list[str]
+    reason: str | None
+    standalone: bool  # comment-only line -> also covers the next code line
+    used: bool = False
+
+
+@dataclass
+class ModuleFile:
+    """One parsed source file plus its lazily-computed analyses."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    aliases: dict[str, str]
+    suppressions: list[Suppression]
+    _trace: TraceInfo | None = field(default=None, repr=False)
+
+    @property
+    def trace(self) -> TraceInfo:
+        if self._trace is None:
+            self._trace = analyze_tracing(self.tree, self.aliases)
+        return self._trace
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "ModuleFile":
+        tree = ast.parse(source, filename=path)
+        return cls(
+            path=path,
+            source=source,
+            tree=tree,
+            aliases=build_alias_map(tree),
+            suppressions=_parse_suppressions(source),
+        )
+
+
+@dataclass
+class Project:
+    """All modules under analysis (cross-file rules read the whole set)."""
+
+    modules: list[ModuleFile]
+
+    def by_basename(self, name: str) -> list[ModuleFile]:
+        return [m for m in self.modules if m.path.endswith(name)]
+
+
+def _parse_suppressions(source: str) -> list[Suppression]:
+    out: list[Suppression] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _SUPPRESS_RE.search(tok.string)
+            if not match:
+                continue
+            rules = [r.strip() for r in match.group(1).split(",") if r.strip()]
+            reason = match.group(2)
+            standalone = tok.line.strip().startswith("#")
+            out.append(
+                Suppression(
+                    line=tok.start[0],
+                    rules=rules,
+                    reason=reason if reason else None,
+                    standalone=standalone,
+                )
+            )
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def apply_suppressions(
+    module: ModuleFile, violations: list[Violation], known_rules: set[str]
+) -> list[Violation]:
+    """Drops violations covered by a well-formed inline suppression; emits
+    ``bad-suppression`` for unreasoned or unknown-rule disables (those are
+    not themselves suppressible — the enforcement would be circular)."""
+    source_lines = module.source.splitlines()
+    covered_lines: dict[int, list[Suppression]] = {}
+    for sup in module.suppressions:
+        covered_lines.setdefault(sup.line, []).append(sup)
+        if sup.standalone:
+            # A comment-only suppression covers the next CODE line — blank
+            # lines and continuation comments (a multi-line reason) between
+            # the marker and the code are skipped.
+            for idx in range(sup.line, len(source_lines)):
+                text = source_lines[idx].strip()
+                if text and not text.startswith("#"):
+                    covered_lines.setdefault(idx + 1, []).append(sup)
+                    break
+
+    kept: list[Violation] = []
+    for v in violations:
+        suppressed = False
+        for sup in covered_lines.get(v.line, []):
+            if v.rule in sup.rules and sup.reason:
+                sup.used = True
+                suppressed = True
+        if not suppressed:
+            kept.append(v)
+
+    for sup in module.suppressions:
+        malformed = False
+        if not sup.reason:
+            malformed = True
+            kept.append(
+                Violation(
+                    rule="bad-suppression",
+                    path=module.path,
+                    line=sup.line,
+                    col=0,
+                    message=(
+                        "suppression without a reason: write "
+                        "'# graftlint: disable=<rule> -- <why this is safe>'"
+                    ),
+                )
+            )
+        for rule in sup.rules:
+            if rule not in known_rules:
+                malformed = True
+                kept.append(
+                    Violation(
+                        rule="bad-suppression",
+                        path=module.path,
+                        line=sup.line,
+                        col=0,
+                        message=f"suppression names unknown rule {rule!r}",
+                    )
+                )
+        # A well-formed suppression that silenced nothing is stale — the
+        # code it excused was fixed or moved. Report it so disables are
+        # cleaned up the moment they stop earning their keep.
+        if not malformed and not sup.used:
+            kept.append(
+                Violation(
+                    rule="bad-suppression",
+                    path=module.path,
+                    line=sup.line,
+                    col=0,
+                    message=(
+                        "unused suppression: no "
+                        f"{'/'.join(sup.rules)} violation on the covered "
+                        "line — remove the disable comment"
+                    ),
+                )
+            )
+    return kept

@@ -1,0 +1,75 @@
+"""Lint driver: file discovery, rule execution, suppression, formatting."""
+
+from __future__ import annotations
+
+import os
+
+from .core import ModuleFile, Project, Violation, apply_suppressions
+from .rules import ALL_RULES, Rule
+
+RULES: dict[str, Rule] = {rule.id: rule for rule in ALL_RULES}
+
+#: rule ids valid in a ``# graftlint: disable=`` comment.
+KNOWN_RULE_IDS = set(RULES) | {"bad-suppression"}
+
+_EXCLUDED_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def _collect_files(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames if d not in _EXCLUDED_DIRS]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    files.append(os.path.join(dirpath, f))
+    return sorted(dict.fromkeys(files))
+
+
+def _lint_project(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for module in project.modules:
+        found: list[Violation] = []
+        for rule in ALL_RULES:
+            found.extend(rule.check(module, project))
+        out.extend(apply_suppressions(module, found, KNOWN_RULE_IDS))
+    return sorted(set(out), key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def lint_sources(sources: dict[str, str]) -> list[Violation]:
+    """Lints in-memory ``{path: source}`` pairs (the unit-test entry point).
+    Unparseable files produce a ``parse-error`` violation rather than a
+    crash."""
+    modules: list[ModuleFile] = []
+    errors: list[Violation] = []
+    for path, source in sources.items():
+        try:
+            modules.append(ModuleFile.parse(path, source))
+        except SyntaxError as exc:
+            errors.append(
+                Violation(
+                    rule="parse-error",
+                    path=path,
+                    line=exc.lineno or 0,
+                    col=exc.offset or 0,
+                    message=f"could not parse: {exc.msg}",
+                )
+            )
+    return errors + _lint_project(Project(modules=modules))
+
+
+def lint_source(source: str, path: str = "<string>.py") -> list[Violation]:
+    """Lints one in-memory module."""
+    return lint_sources({path: source})
+
+
+def lint_paths(paths: list[str]) -> list[Violation]:
+    """Lints every ``*.py`` under the given files/directories."""
+    sources: dict[str, str] = {}
+    for f in _collect_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            sources[f] = fh.read()
+    return lint_sources(sources)

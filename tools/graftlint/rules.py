@@ -1,0 +1,594 @@
+"""The graftlint rule set — 8 JAX-specific hazard detectors.
+
+Every rule yields :class:`~tools.graftlint.core.Violation` objects and is
+registered in :data:`ALL_RULES`. Rules are heuristics tuned against this
+codebase: false-positive-averse first (tier-1 enforces a clean tree), and
+each carries at least one positive and one negative unit test in
+``tests/test_graftlint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from .core import ModuleFile, Project, Violation
+from .tracing import (
+    is_device_call,
+    iter_traced_functions,
+    param_names,
+    resolve_dotted,
+    taint_names,
+    unwrap_partial,
+)
+
+
+class Rule:
+    id: str = ""
+    summary: str = ""
+
+    def check(self, module: ModuleFile, project: Project) -> Iterator[Violation]:
+        raise NotImplementedError
+
+    def _v(self, module: ModuleFile, node: ast.AST, message: str) -> Violation:
+        return Violation(
+            rule=self.id,
+            path=module.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+def _scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of one lexical scope, not descending into nested function
+    bodies (those are their own scopes)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _store_names(node: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+
+
+class PRNGReuseRule(Rule):
+    id = "prng-reuse"
+    summary = (
+        "a PRNG key variable feeds two jax.random consumers (or one inside "
+        "a loop) without jax.random.split — identical randomness, silently"
+    )
+
+    #: jax.random functions that do NOT consume the key's entropy budget.
+    NONCONSUMING = {
+        "split", "fold_in", "PRNGKey", "key", "wrap_key_data", "key_data",
+        "clone", "key_impl",
+    }
+
+    def _consumer_key_arg(self, call: ast.Call, module: ModuleFile) -> str | None:
+        resolved = resolve_dotted(call.func, module.aliases)
+        if not resolved or not resolved.startswith("jax.random."):
+            return None
+        if resolved.rpartition(".")[2] in self.NONCONSUMING:
+            return None
+        if call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        return None
+
+    def check(self, module, project):
+        scopes: list[ast.AST] = [module.tree]
+        scopes += [
+            n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            yield from self._check_scope(scope, module)
+
+    def _check_scope(self, scope, module):
+        events: list[tuple[int, int, str, str, ast.AST]] = []
+        for node in _scope_nodes(scope):
+            if isinstance(node, ast.Call):
+                key = self._consumer_key_arg(node, module)
+                if key is not None:
+                    events.append((node.lineno, node.col_offset, "use", key, node))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.For)):
+                target = node.target if not isinstance(node, ast.Assign) else node
+                for name in _store_names(target):
+                    events.append(
+                        (node.lineno, getattr(node, "col_offset", 0), "def", name, node)
+                    )
+        loops = [
+            n for n in _scope_nodes(scope) if isinstance(n, (ast.For, ast.While))
+        ]
+
+        used: set[str] = set()
+        for _, _, kind, name, node in sorted(events, key=lambda e: (e[0], e[1])):
+            if kind == "def":
+                used.discard(name)
+                continue
+            if name in used:
+                yield self._v(
+                    module,
+                    node,
+                    f"PRNG key {name!r} already consumed in this scope; "
+                    "split it (jax.random.split) before reusing",
+                )
+            used.add(name)
+            for loop in loops:
+                if self._node_in(node, loop) and name not in _store_names(loop):
+                    yield self._v(
+                        module,
+                        node,
+                        f"PRNG key {name!r} consumed inside a loop without "
+                        "re-splitting — every iteration draws identical "
+                        "randomness",
+                    )
+                    break
+
+    @staticmethod
+    def _node_in(node: ast.AST, container: ast.AST) -> bool:
+        return any(n is node for n in ast.walk(container))
+
+
+class HostNumpyInTraceRule(Rule):
+    id = "host-numpy-in-trace"
+    summary = (
+        "a host numpy call receives a traced/device value inside a "
+        "jitted/scanned function — baked-constant or trace error"
+    )
+
+    def check(self, module, project):
+        seen: set[tuple[int, int]] = set()
+        for fn in iter_traced_functions(module.tree, module.trace):
+            tainted = taint_names(fn, module.aliases, include_params=True)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = resolve_dotted(node.func, module.aliases)
+                if not resolved or not resolved.startswith("numpy."):
+                    continue
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if not any(self._tainted_expr(a, tainted, module) for a in args):
+                    continue
+                pos = (node.lineno, node.col_offset)
+                if pos in seen:
+                    continue
+                seen.add(pos)
+                yield self._v(
+                    module,
+                    node,
+                    f"host-numpy call {resolved.replace('numpy.', 'np.', 1)!r} "
+                    "on a traced value inside a traced function — use the "
+                    "jnp equivalent",
+                )
+
+    @staticmethod
+    def _tainted_expr(expr, tainted, module):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in tainted:
+                    return True
+            if isinstance(node, ast.Call) and is_device_call(node, module.aliases):
+                return True
+        return False
+
+
+class TracerBranchRule(Rule):
+    id = "tracer-branch"
+    summary = (
+        "Python if/while branches on a tracer-derived value inside a traced "
+        "function — TracerBoolConversionError, or silently-static branch"
+    )
+
+    #: device-namespace calls whose results are static (shape metadata).
+    STATIC_QUERY_TAILS = {"ndim", "shape", "size", "result_type", "issubdtype"}
+
+    def check(self, module, project):
+        seen: set[tuple[int, int]] = set()
+        for fn in iter_traced_functions(module.tree, module.trace):
+            tainted = taint_names(fn, module.aliases, include_params=False)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if not self._test_is_traced(node.test, tainted, module):
+                    continue
+                pos = (node.lineno, node.col_offset)
+                if pos in seen:
+                    continue
+                seen.add(pos)
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield self._v(
+                    module,
+                    node,
+                    f"Python `{kind}` on a tracer-derived value inside a "
+                    "traced function — use lax.cond / lax.select / "
+                    "lax.while_loop",
+                )
+
+    def _test_is_traced(self, test, tainted, module):
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in tainted:
+                    return True
+            if isinstance(node, ast.Call) and is_device_call(node, module.aliases):
+                resolved = resolve_dotted(node.func, module.aliases) or ""
+                if resolved.rpartition(".")[2] not in self.STATIC_QUERY_TAILS:
+                    return True
+        return False
+
+
+def _jit_sites(module: ModuleFile):
+    """Yields ``(site_node, wrapped, static_kwnames, assign_name)`` for
+    every ``jax.jit``/``pjit`` call site and decorator in the module.
+
+    ``wrapped`` is the callable expression being jitted (the FunctionDef
+    itself for decorator form); ``assign_name`` is the name the compiled
+    function is bound to, when the site is the RHS of an assignment.
+    """
+    jit_tails = {"jit", "pjit"}
+
+    def is_jit(node) -> bool:
+        resolved = resolve_dotted(node, module.aliases)
+        return bool(resolved) and resolved.rpartition(".")[2] in jit_tails and (
+            resolved.startswith("jax") or resolved == "pjit"
+        )
+
+    assign_names: dict[int, str] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            target = node.targets[0]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute):
+                name = target.attr
+            elif isinstance(target, ast.Subscript):
+                name = (
+                    target.value.attr
+                    if isinstance(target.value, ast.Attribute)
+                    else getattr(target.value, "id", None)
+                )
+            if name:
+                assign_names[id(node.value)] = name
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and is_jit(node.func):
+            if not node.args:
+                continue
+            kwnames = {kw.arg for kw in node.keywords if kw.arg}
+            yield node, node.args[0], kwnames, assign_names.get(id(node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit(dec):
+                    yield dec, node, set(), node.name
+                elif isinstance(dec, ast.Call):
+                    kwnames = {kw.arg for kw in dec.keywords if kw.arg}
+                    if is_jit(dec.func):
+                        yield dec, node, kwnames, node.name
+                    elif (
+                        resolve_dotted(dec.func, module.aliases)
+                        in ("functools.partial", "partial")
+                        and dec.args
+                        and is_jit(dec.args[0])
+                    ):
+                        yield dec, node, kwnames, node.name
+
+
+def _wrapped_params(wrapped: ast.AST, module: ModuleFile):
+    """Parameter names of the callable being jitted, or None when the
+    callable is defined elsewhere. Returns ``(params, was_partial)``."""
+    if isinstance(wrapped, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return param_names(wrapped), False
+    inner, was_partial = unwrap_partial(wrapped, module.aliases)
+    if isinstance(inner, ast.Lambda):
+        return param_names(inner), was_partial
+    name = None
+    if isinstance(inner, ast.Name):
+        name = inner.id
+    elif isinstance(inner, ast.Attribute) and isinstance(inner.value, ast.Name):
+        if inner.value.id in ("self", "cls"):
+            name = inner.attr
+    if name:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == name:
+                    return param_names(node), was_partial
+    return None, was_partial
+
+
+def _wrapped_name(wrapped: ast.AST, module: ModuleFile) -> str | None:
+    if isinstance(wrapped, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return wrapped.name
+    inner, _ = unwrap_partial(wrapped, module.aliases)
+    if isinstance(inner, ast.Name):
+        return inner.id
+    if isinstance(inner, ast.Attribute):
+        return inner.attr
+    return None
+
+
+class JitStaticConfigRule(Rule):
+    id = "jit-static-config"
+    summary = (
+        "a jit/pjit site whose wrapped function takes a config-shaped "
+        "argument without static_argnames — retrace/recompile hazard"
+    )
+
+    CONFIG_NAMES = {
+        "config", "cfg", "flags", "opts", "options", "hparams", "settings",
+        "hps", "mode",
+    }
+    CONFIG_SUFFIXES = ("_config", "_cfg", "_flags", "_opts", "_options")
+
+    def _is_config_param(self, name: str) -> bool:
+        return name in self.CONFIG_NAMES or name.endswith(self.CONFIG_SUFFIXES)
+
+    def check(self, module, project):
+        for site, wrapped, kwnames, _assign in _jit_sites(module):
+            if kwnames & {"static_argnames", "static_argnums"}:
+                continue
+            params, was_partial = _wrapped_params(wrapped, module)
+            if params is None or was_partial:
+                # partial() binds its config at wrap time — static by
+                # construction; unresolvable callables are skipped.
+                continue
+            config_params = [p for p in params if self._is_config_param(p)]
+            if config_params:
+                yield self._v(
+                    module,
+                    site,
+                    f"jit of a function taking config-shaped argument(s) "
+                    f"{config_params} without static_argnames — every "
+                    "config change retraces silently, and unhashable "
+                    "configs retrace per call",
+                )
+
+
+class MissingDonateRule(Rule):
+    id = "missing-donate"
+    summary = (
+        "a train-step-shaped jit (threads a state pytree through an update) "
+        "without donate_argnums — doubles peak device memory"
+    )
+
+    STATE_PARAMS = {"state", "train_state", "carry", "opt_state", "learner_state"}
+    TRAIN_RE = re.compile(r"train|update")
+    EXEMPT_RE = re.compile(r"eval|valid|test|predict|infer|loss|lower|apply")
+
+    def check(self, module, project):
+        for site, wrapped, kwnames, assign_name in _jit_sites(module):
+            if kwnames & {"donate_argnums", "donate_argnames"}:
+                continue
+            candidates = [
+                n for n in (_wrapped_name(wrapped, module), assign_name) if n
+            ]
+            if not candidates:
+                continue
+            if any(self.EXEMPT_RE.search(n) for n in candidates):
+                continue
+            if not any(self.TRAIN_RE.search(n) for n in candidates):
+                continue
+            params, _ = _wrapped_params(wrapped, module)
+            if not params or params[0] not in self.STATE_PARAMS:
+                continue
+            yield self._v(
+                module,
+                site,
+                f"train-step jit of {candidates[0]!r} threads state param "
+                f"{params[0]!r} without donate_argnums — the old state "
+                "buffer stays live across the update (2x peak memory)",
+            )
+
+
+class DeadFlagRule(Rule):
+    id = "dead-flag"
+    summary = (
+        "a CLI flag defined in utils/parser_utils.py that no scanned module "
+        "reads — config surface rot (needs a full-tree scan to fire)"
+    )
+
+    #: Minimum distinct modules with flag reads before the scan is trusted
+    #: as complete enough to call anything dead (see the guard below).
+    MIN_READING_MODULES = 4
+
+    def check(self, module, project):
+        if not module.path.endswith("parser_utils.py"):
+            return
+        flags: list[tuple[str, ast.Call]] = []
+        defining_fns: list[ast.AST] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_add = (isinstance(node.func, ast.Name) and node.func.id == "add") or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+            )
+            if not is_add or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                if first.value.startswith("--"):
+                    flags.append((first.value.lstrip("-"), node))
+        if not flags:
+            return
+        flag_lines = {id(call) for _, call in flags}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(
+                    isinstance(sub, ast.Call) and id(sub) in flag_lines
+                    for sub in ast.walk(node)
+                ):
+                    defining_fns.append(node)
+
+        reads: set[str] = set()
+        reading_modules: set[str] = set()
+        names = {name for name, _ in flags}
+        for mod in project.modules:
+            skip_nodes: set[int] = set()
+            if mod is module:
+                for fn in defining_fns:
+                    skip_nodes.update(id(n) for n in ast.walk(fn))
+            for node in ast.walk(mod.tree):
+                if id(node) in skip_nodes:
+                    continue
+                hit = None
+                if isinstance(node, ast.Attribute) and node.attr in names:
+                    hit = node.attr
+                elif isinstance(node, ast.keyword) and node.arg in names:
+                    hit = node.arg
+                elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    if node.value in names:
+                        hit = node.value
+                if hit is not None:
+                    reads.add(hit)
+                    reading_modules.add(mod.path)
+        # Partial-scan guard: "dead" is relative to the scanned file set.
+        # Linting parser_utils.py alone (or any changed-files subset) would
+        # report every flag whose consumers weren't scanned — a wall of
+        # false positives. Flag consumers span the whole tree (models/,
+        # data/, experiment runtime, entry points, tests), so the rule only
+        # trusts a scan in which reads come from several distinct modules;
+        # the tier-1 gate always scans the full tree, which is where the
+        # rule enforces.
+        if len(reading_modules) < self.MIN_READING_MODULES:
+            return
+        for name, call in flags:
+            if name not in reads:
+                yield self._v(
+                    module,
+                    call,
+                    f"flag --{name} is defined but never read by any scanned "
+                    "module — delete it or wire it to a consumer",
+                )
+
+
+class DeviceOpInDataPathRule(Rule):
+    id = "device-op-in-data-path"
+    summary = (
+        "jax/jnp imported in the host-side data path — episode synthesis "
+        "must stay on host numpy (device transfers belong to the step)"
+    )
+
+    HOST_DATA_FILES = ("data/loader.py", "data/dataset.py", "data/augment.py")
+
+    def check(self, module, project):
+        if not module.path.replace("\\", "/").endswith(self.HOST_DATA_FILES):
+            return
+        for node in ast.walk(module.tree):
+            modname = None
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        modname = a.name
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                if node.module and (
+                    node.module == "jax" or node.module.startswith("jax.")
+                ):
+                    modname = node.module
+            if modname:
+                yield self._v(
+                    module,
+                    node,
+                    f"{modname!r} imported in the host data path — jnp ops "
+                    "here force host->device transfers per episode; keep "
+                    "synthesis in numpy and decode on device in the step",
+                )
+
+
+class TracedMutationRule(Rule):
+    id = "traced-mutation"
+    summary = (
+        "captured Python state mutated inside a traced function — runs once "
+        "at trace time, then never again (silent staleness)"
+    )
+
+    MUTATORS = {
+        "append", "extend", "insert", "setdefault", "remove", "discard",
+        "clear", "popitem",
+    }
+
+    def check(self, module, project):
+        seen: set[tuple[int, int]] = set()
+        for fn in iter_traced_functions(module.tree, module.trace):
+            local = set(param_names(fn)) | {
+                n.id
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+            }
+            for node in ast.walk(fn):
+                v = self._check_node(node, local, module, fn)
+                if v is not None:
+                    pos = (v.line, v.col)
+                    if pos not in seen:
+                        seen.add(pos)
+                        yield v
+
+    def _base_name(self, node: ast.AST) -> str | None:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _check_node(self, node, local, module, fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            return self._v(
+                module,
+                node,
+                f"`{kind} {', '.join(node.names)}` write inside a traced "
+                "function — executes at trace time only; thread the value "
+                "through the carry/return instead",
+            )
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    base = self._base_name(t)
+                    if base == "self" or (
+                        base is not None
+                        and base not in local
+                        and base not in module.aliases
+                    ):
+                        return self._v(
+                            module,
+                            node,
+                            f"mutation of captured object {base!r} inside a "
+                            "traced function — happens once at trace time, "
+                            "not per step; return the value instead",
+                        )
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in self.MUTATORS:
+                base = self._base_name(node.func.value)
+                if base == "self" or (
+                    base is not None
+                    and base not in local
+                    and base not in module.aliases
+                ):
+                    return self._v(
+                        module,
+                        node,
+                        f".{node.func.attr}() on captured object {base!r} "
+                        "inside a traced function — mutates at trace time "
+                        "only; accumulate via scan/carry instead",
+                    )
+        return None
+
+
+ALL_RULES: list[Rule] = [
+    PRNGReuseRule(),
+    HostNumpyInTraceRule(),
+    TracerBranchRule(),
+    JitStaticConfigRule(),
+    MissingDonateRule(),
+    DeadFlagRule(),
+    DeviceOpInDataPathRule(),
+    TracedMutationRule(),
+]

@@ -1,0 +1,314 @@
+"""Shared AST analysis: import resolution, traced-function discovery, taint.
+
+Everything here is heuristic by design — graftlint trades soundness for
+zero-dependency, zero-execution analysis of one module at a time. The two
+load-bearing ideas:
+
+* **Traced-function discovery.** A function is *traced* when JAX may call it
+  under ``jit``/``grad``/``vmap``/``scan``/... — seeded from decorator and
+  call sites (``jax.jit(f)``, ``lax.scan(body, ...)``, ``@jax.jit``,
+  ``functools.partial(jax.jit, ...)``) and closed transitively over the
+  module-local call graph (a helper called from a traced function runs under
+  the same trace).
+
+* **Taint.** Within a traced function, names holding (likely) tracer values:
+  results of ``jnp.``/``lax.``/``jax.`` calls, anything assigned from a
+  tainted expression, and (optionally) the function's own parameters.
+  Iterated to a fixpoint so statement order doesn't matter.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+#: Last attribute component of callables that trace a callable argument,
+#: valid only under a JAX namespace root (see :func:`resolve_dotted`).
+TRACE_WRAPPER_TAILS = {
+    "jit",
+    "pjit",
+    "pmap",
+    "vmap",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "remat",
+    "custom_jvp",
+    "custom_vjp",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "map",
+    "associative_scan",
+}
+
+#: Namespace roots under which the tails above count as tracers.
+JAX_ROOTS = ("jax", "jax.lax", "jax.numpy", "jax.experimental.pjit")
+
+#: Namespace roots whose call results are treated as device/tracer values.
+DEVICE_ROOTS = ("jax.numpy", "jax.lax", "jax.nn", "jax.random", "jax.scipy")
+
+
+def build_alias_map(tree: ast.Module) -> dict[str, str]:
+    """Maps local names to fully-dotted module paths from import statements
+    (``import jax.numpy as jnp`` -> ``{"jnp": "jax.numpy"}``,
+    ``from jax import lax`` -> ``{"lax": "jax.lax"}``)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_dotted(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Fully-resolved dotted path of a Name/Attribute chain (``jnp.mean`` ->
+    ``jax.numpy.mean``), or None for non-chain expressions."""
+    dotted = dotted_name(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def _under_root(resolved: str | None, roots: tuple[str, ...]) -> bool:
+    if resolved is None:
+        return False
+    return any(resolved == r or resolved.startswith(r + ".") for r in roots)
+
+
+def is_trace_entry(call_func: ast.AST, aliases: dict[str, str]) -> bool:
+    """Whether a Call's func is a JAX transform that traces callable args.
+
+    ``jax.tree.map``/``tree_util.tree_map`` are deliberately NOT entries:
+    their callbacks run eagerly host-side outside a trace (idiomatic with
+    numpy in tests). When a tree.map sits inside an already-traced function
+    its callback body is still scanned — nested lambdas are walked as part
+    of the enclosing traced function.
+    """
+    resolved = resolve_dotted(call_func, aliases)
+    if resolved is None:
+        return False
+    root, _, tail = resolved.rpartition(".")
+    if root in ("jax.tree", "jax.tree_util"):
+        return False
+    return tail in TRACE_WRAPPER_TAILS and _under_root(root or resolved, JAX_ROOTS)
+
+
+def is_device_call(call: ast.Call, aliases: dict[str, str]) -> bool:
+    """Whether a call's result is (likely) a tracer/device value."""
+    return _under_root(resolve_dotted(call.func, aliases), DEVICE_ROOTS)
+
+
+def unwrap_partial(node: ast.AST, aliases: dict[str, str]) -> tuple[ast.AST, bool]:
+    """Peels ``functools.partial(f, ...)`` layers; returns ``(innermost,
+    was_partial)``."""
+    was_partial = False
+    while (
+        isinstance(node, ast.Call)
+        and resolve_dotted(node.func, aliases) in ("functools.partial", "partial")
+        and node.args
+    ):
+        node = node.args[0]
+        was_partial = True
+    return node, was_partial
+
+
+def _callable_ref_names(node: ast.AST, aliases: dict[str, str]) -> list[str]:
+    """Bare names a callable-reference expression points at: ``f`` -> [f],
+    ``self._train_step`` -> [_train_step], ``functools.partial(f, ...)`` ->
+    [f]. Lambdas return [] (handled as nodes, not names)."""
+    node, _ = unwrap_partial(node, aliases)
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        # Only own-module references: self.method / cls.method. A dotted
+        # library path (optax.adam) resolves and is skipped.
+        if isinstance(node.value, ast.Name) and node.value.id in ("self", "cls"):
+            return [node.attr]
+    return []
+
+
+@dataclass
+class TraceInfo:
+    """Traced-function analysis for one module."""
+
+    traced_names: set[str] = field(default_factory=set)
+    traced_nodes: set[int] = field(default_factory=set)  # id() of def/lambda
+    _defs_by_name: dict[str, list[ast.AST]] = field(default_factory=dict)
+
+    def is_traced(self, node: ast.AST) -> bool:
+        if id(node) in self.traced_nodes:
+            return True
+        name = getattr(node, "name", None)
+        return name is not None and name in self.traced_names
+
+
+def analyze_tracing(tree: ast.Module, aliases: dict[str, str]) -> TraceInfo:
+    info = TraceInfo()
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    info._defs_by_name = defs
+
+    seed_names: set[str] = set()
+    seed_lambdas: list[ast.Lambda] = []
+
+    def seed_callable(arg: ast.AST) -> None:
+        inner, _ = unwrap_partial(arg, aliases)
+        if isinstance(inner, ast.Lambda):
+            seed_lambdas.append(inner)
+        else:
+            seed_names.update(_callable_ref_names(arg, aliases))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and is_trace_entry(node.func, aliases):
+            for arg in node.args:
+                seed_callable(arg)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if is_trace_entry(target, aliases):
+                    seed_names.add(node.name)
+                # functools.partial(jax.jit, ...) used as a decorator
+                if (
+                    isinstance(dec, ast.Call)
+                    and resolve_dotted(dec.func, aliases)
+                    in ("functools.partial", "partial")
+                    and dec.args
+                    and is_trace_entry(dec.args[0], aliases)
+                ):
+                    seed_names.add(node.name)
+
+    # Transitive closure over the module-local call graph: every name called
+    # (or referenced as a callable) inside a traced function is traced too.
+    info.traced_names = set(seed_names)
+    for lam in seed_lambdas:
+        info.traced_nodes.add(id(lam))
+
+    def called_names(fn: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                out.update(_callable_ref_names(node.func, aliases))
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    out.update(_callable_ref_names(arg, aliases))
+        return out
+
+    frontier: list[ast.AST] = list(seed_lambdas)
+    for name in seed_names:
+        frontier.extend(defs.get(name, []))
+    seen_ids = {id(f) for f in frontier}
+    while frontier:
+        fn = frontier.pop()
+        info.traced_nodes.add(id(fn))
+        for name in called_names(fn):
+            if name in info.traced_names:
+                continue
+            if name in defs:
+                info.traced_names.add(name)
+                for d in defs[name]:
+                    if id(d) not in seen_ids:
+                        seen_ids.add(id(d))
+                        frontier.append(d)
+    return info
+
+
+def iter_traced_functions(tree: ast.Module, info: TraceInfo):
+    """Yields every FunctionDef/Lambda node the analysis marked traced."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if info.is_traced(node):
+                yield node
+
+
+def param_names(fn: ast.AST) -> list[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _assigned_names(target: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+    return out
+
+
+def taint_names(
+    fn: ast.AST, aliases: dict[str, str], include_params: bool
+) -> set[str]:
+    """Names (likely) bound to tracer/device values inside ``fn``, computed
+    to a fixpoint over the function's assignments. Nested function bodies are
+    included — their device results flow through the same local names often
+    enough that excluding them loses real findings."""
+    tainted: set[str] = set(param_names(fn)) if include_params else set()
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and is_device_call(node, aliases):
+                return True
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in tainted:
+                    return True
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            targets: list[ast.AST] = []
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and node.value:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.For):
+                targets, value = [node.target], node.iter
+            if value is None or not expr_tainted(value):
+                continue
+            for t in targets:
+                new = _assigned_names(t) - tainted
+                if new:
+                    tainted |= new
+                    changed = True
+    return tainted
+
+
+def expr_references_taint(
+    expr: ast.AST, tainted: set[str], aliases: dict[str, str]
+) -> bool:
+    """Whether an expression touches a tainted name or a direct device call."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in tainted:
+                return True
+        if isinstance(node, ast.Call) and is_device_call(node, aliases):
+            return True
+    return False
