@@ -26,12 +26,16 @@ builder (``self.train_state``) and threaded through ``run_train_iter`` /
 from __future__ import annotations
 
 import os
+import signal
 import sys
+import threading
 import time
 
 import jax
 import numpy as np
 
+from .utils import faultinject
+from .utils.checkpoint import CheckpointCorruptError, publish_alias
 from .utils.storage import (
     build_experiment_folder,
     save_statistics,
@@ -44,6 +48,34 @@ from .utils.storage import (
 #: cadence — the old ``% 100`` check fired half as often (5x per 500-iter
 #: epoch at K=25 vs the K=1 path's 10x; VERDICT r3 weak #5).
 TRAIN_LOG_EVERY = 50
+
+#: Exit code of a preemption-triggered shutdown (``EX_TEMPFAIL``): the run
+#: wrote a valid emergency checkpoint and is safe to requeue with
+#: ``--continue_from_epoch latest``. Distinct from 0 (finished) and 1
+#: (crashed) so schedulers can tell "requeue me" from "give up".
+REQUEUE_EXIT_CODE = 75
+
+#: Hard cap on divergence-sentinel rollbacks per process: each rollback
+#: shifts the data seed window past the offending batch, so repeated trips
+#: mean the run itself is unstable — stop instead of thrashing the disk.
+MAX_ROLLBACKS_PER_RUN = 5
+
+
+class NonFiniteLossError(RuntimeError):
+    """The divergence sentinel tripped (non-finite meta-loss) under the
+    ``halt`` policy, or exhausted the ``rollback`` budget. Raised BEFORE the
+    poisoned state can reach a checkpoint."""
+
+
+class _RollbackSignal(Exception):
+    """Internal control flow: unwinds the train loop to the rollback
+    handler. Carries the iteration count at detection time and how many
+    dispatch samples tripped the sentinel."""
+
+    def __init__(self, trip_iter: int, trips: float = 1.0):
+        super().__init__(trip_iter)
+        self.trip_iter = int(trip_iter)
+        self.trips = float(trips)
 
 
 def _multi_log_due(current_iter: int, chunk: int) -> bool:
@@ -61,6 +93,21 @@ class ExperimentBuilder:
         symmetry with the reference)."""
         self.args, self.device = args, device
         self.model = model
+        self._data_cls = data
+        # Divergence sentinel policy (see parser_utils --on_nonfinite).
+        self.on_nonfinite = str(
+            getattr(args, "on_nonfinite", "halt") or "halt"
+        ).lower()
+        if self.on_nonfinite not in ("halt", "skip", "rollback"):
+            raise ValueError(
+                f"on_nonfinite must be halt|skip|rollback, got "
+                f"{self.on_nonfinite!r}"
+            )
+        # Preemption-safe shutdown (SIGTERM/SIGINT -> flag -> emergency
+        # checkpoint + requeue exit at the next dispatch boundary).
+        self._shutdown_signum: int | None = None
+        self._prev_handlers: dict[int, object] = {}
+        self._rollbacks_this_run = 0
         # 32 of the reference's 38 configs lack the "model" key its builder
         # reads unconditionally (fork regression, SURVEY §7) — tolerate it.
         self.model_type = getattr(args, "model", None)
@@ -82,14 +129,8 @@ class ExperimentBuilder:
         if args.continue_from_epoch == "from_scratch":
             self.create_summary_csv = True
         elif args.continue_from_epoch == "latest":
-            checkpoint = os.path.join(self.saved_models_filepath, "train_model_latest")
             print("attempting to find existing checkpoint")
-            if os.path.exists(checkpoint):
-                self.train_state, self.state = self.model.load_model(
-                    model_save_dir=self.saved_models_filepath,
-                    model_name="train_model",
-                    model_idx="latest",
-                )
+            if self._resume_from_latest():
                 self.start_epoch = int(
                     self.state["current_iter"] / args.total_iter_per_epoch
                 )
@@ -166,8 +207,25 @@ class ExperimentBuilder:
                     for v in host_losses[key]
                 ]
             )
-            summary_losses[f"{phase}_{key}_mean"] = np.mean(values)
-            summary_losses[f"{phase}_{key}_std"] = np.std(values)
+            if key == "nonfinite":
+                # Divergence-sentinel trip count for the epoch (one 0/1
+                # sample per meta-update), not a mean/std pair.
+                summary_losses[f"{phase}_nonfinite_trips"] = float(
+                    np.sum(values)
+                )
+                continue
+            # Finite-masked statistics: a single non-finite sample must not
+            # poison the epoch summary (and with it per_epoch_statistics,
+            # the CSV and the best-val tracking) — trips are reported
+            # separately via {phase}_nonfinite_trips. All-finite epochs are
+            # bit-identical to the unmasked math.
+            finite = values[np.isfinite(values)]
+            summary_losses[f"{phase}_{key}_mean"] = (
+                np.mean(finite) if finite.size else float("nan")
+            )
+            summary_losses[f"{phase}_{key}_std"] = (
+                np.std(finite) if finite.size else float("nan")
+            )
         return summary_losses
 
     @staticmethod
@@ -187,6 +245,286 @@ class ExperimentBuilder:
         z = first_dict.copy()
         z.update(second_dict)
         return z
+
+    # ------------------------------------------------------------------
+    # Fault tolerance: resume fallback, preemption shutdown, sentinel
+    # ------------------------------------------------------------------
+
+    def _checkpoint_path(self, model_idx) -> str:
+        return os.path.join(self.saved_models_filepath, f"train_model_{model_idx}")
+
+    def _saved_epoch_indices(self) -> list[int]:
+        """Epoch indices with an on-disk ``train_model_<e>`` file, newest
+        first."""
+        indices = []
+        for name in os.listdir(self.saved_models_filepath):
+            suffix = name[len("train_model_"):]
+            if name.startswith("train_model_") and suffix.isdigit():
+                indices.append(int(suffix))
+        return sorted(indices, reverse=True)
+
+    def _resume_from_latest(self) -> bool:
+        """Loads the newest VALID checkpoint into ``train_state``/``state``.
+
+        Tries ``latest`` first, then every epoch file newest-first. A
+        corrupt candidate (truncation, bit-rot — ``CheckpointCorruptError``)
+        is quarantined with a ``.corrupt`` suffix and the scan degrades to
+        the next one, instead of crashing resume with an opaque zipfile
+        error. Structural mismatches (``ValueError``) still propagate: older
+        checkpoints would mismatch identically, so falling back cannot help.
+        Returns False when nothing valid exists (caller starts from
+        scratch)."""
+        candidates: list = []
+        if os.path.exists(self._checkpoint_path("latest")):
+            candidates.append("latest")
+        candidates.extend(self._saved_epoch_indices())
+        for model_idx in candidates:
+            path = self._checkpoint_path(model_idx)
+            try:
+                self.train_state, self.state = self.model.load_model(
+                    model_save_dir=self.saved_models_filepath,
+                    model_name="train_model",
+                    model_idx=model_idx,
+                )
+                print(f"resumed from checkpoint {path}")
+                return True
+            except CheckpointCorruptError as exc:
+                quarantined = path + ".corrupt"
+                try:
+                    os.replace(path, quarantined)
+                except FileNotFoundError:
+                    pass  # vanished concurrently (pruner / duplicate job)
+                print(
+                    f"WARNING: {exc}; quarantined to {quarantined}, "
+                    "falling back to the previous checkpoint",
+                    file=sys.stderr,
+                )
+        return False
+
+    def _install_signal_handlers(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return  # signal.signal only works from the main thread
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[signum] = signal.signal(
+                    signum, self._request_shutdown
+                )
+            except (ValueError, OSError):  # embedded interpreters
+                pass
+
+    def _restore_signal_handlers(self) -> None:
+        for signum, handler in self._prev_handlers.items():
+            try:
+                signal.signal(signum, handler)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers = {}
+
+    def _request_shutdown(self, signum, frame) -> None:
+        del frame
+        if self._shutdown_signum is not None:
+            raise KeyboardInterrupt  # second signal: stop immediately
+        self._shutdown_signum = signum
+        print(
+            f"\nreceived signal {signum}: finishing the in-flight dispatch, "
+            "then emergency checkpoint + requeue exit "
+            f"({REQUEUE_EXIT_CODE})",
+            flush=True,
+        )
+
+    def _write_interruption_row(self) -> None:
+        interruptions = os.path.join(self.logs_filepath, "interruptions.csv")
+        if not os.path.exists(interruptions):
+            save_statistics(
+                self.logs_filepath,
+                ["timestamp", "signal", "current_iter", "epoch"],
+                filename="interruptions.csv",
+                create=True,
+            )
+        save_statistics(
+            self.logs_filepath,
+            [time.time(), int(self._shutdown_signum),
+             int(self.state["current_iter"]), self.epoch],
+            filename="interruptions.csv",
+        )
+
+    def _pending_nonfinite_trips(self) -> float:
+        """Sentinel trips in the epoch-so-far accumulated metrics (forces
+        the pending device scalars — only called on the shutdown path)."""
+        pending = self.total_losses.get("nonfinite")
+        if not pending:
+            return 0.0
+        values = np.concatenate(
+            [
+                np.atleast_1d(np.asarray(v, dtype=np.float64))
+                for v in jax.device_get(pending)
+            ]
+        )
+        return float(np.sum(values))
+
+    def _maybe_emergency_exit(self, write_checkpoint: bool = True) -> None:
+        """Dispatch-boundary check of the shutdown flag: preemption loses at
+        most one dispatch, not the whole epoch. Writes a full emergency
+        checkpoint to ``train_model_latest`` (resume-compatible — the loop
+        restarts mid-epoch from ``current_iter``), appends an audit row to
+        ``logs/interruptions.csv``, and exits with the requeue code.
+
+        ``write_checkpoint=False`` is the test-eval phase's variant: there
+        ``self.state``/``train_state`` hold a RELOADED ensemble checkpoint,
+        so an emergency write would clobber ``latest`` with an old epoch —
+        the phase is stateless and simply re-runs on requeue."""
+        if self._shutdown_signum is None:
+            return
+        if not write_checkpoint:
+            self._write_interruption_row()
+            print(
+                "shutdown requested during the stateless evaluation phase; "
+                f"exiting with requeue code {REQUEUE_EXIT_CODE} (the phase "
+                "re-runs in full on resume)",
+                flush=True,
+            )
+            sys.exit(REQUEUE_EXIT_CODE)
+        # The emergency write must honor the sentinel contract: a NaN that
+        # tripped since the last log-cadence check would otherwise be
+        # persisted over the newest valid checkpoint. Under ``skip`` the
+        # state is clean by construction (on-device select).
+        trips = (
+            self._pending_nonfinite_trips() if self.on_nonfinite != "skip"
+            else 0.0
+        )
+        if trips and self.on_nonfinite == "halt":
+            raise NonFiniteLossError(
+                f"{int(trips)} non-finite meta-loss(es) pending at shutdown "
+                f"(iteration {self.state['current_iter']}, "
+                "--on_nonfinite=halt); refusing to write an emergency "
+                "checkpoint of poisoned state"
+            )
+        path = self._checkpoint_path("latest")
+        if trips:
+            print(
+                "WARNING: non-finite meta-loss pending at shutdown; NOT "
+                "overwriting train_model_latest — the requeued run resumes "
+                "from the last epoch checkpoint and the rollback policy "
+                "handles the replay",
+                file=sys.stderr,
+            )
+        else:
+            self.model.save_model(path, self.train_state, self.state)
+        self._write_interruption_row()
+        print(
+            ("emergency checkpoint written to " + path if not trips
+             else "emergency checkpoint skipped (poisoned state)")
+            + f"; exiting with requeue code {REQUEUE_EXIT_CODE}",
+            flush=True,
+        )
+        sys.exit(REQUEUE_EXIT_CODE)
+
+    def _sentinel_check(self, losses, current_iter: int) -> None:
+        """Host side of the divergence sentinel, called only at points that
+        already force a device read (log cadence, epoch boundaries) so it
+        adds no sync. ``skip`` is resolved on-device (models/common); here
+        ``halt`` raises before the state can be checkpointed and
+        ``rollback`` unwinds to ``_perform_rollback``."""
+        if self.on_nonfinite == "skip":
+            return
+        flag = losses.get("nonfinite")
+        if flag is None:
+            return
+        trips = float(
+            np.sum(np.asarray(jax.device_get(flag), dtype=np.float64))
+        )
+        if trips == 0.0:
+            return
+        if self.on_nonfinite == "halt":
+            raise NonFiniteLossError(
+                f"non-finite meta-loss detected at iteration {current_iter} "
+                "(--on_nonfinite=halt); nothing was checkpointed. Rerun with "
+                "--on_nonfinite=skip/rollback to train through it, or "
+                "--debug_nans to locate the op"
+            )
+        raise _RollbackSignal(current_iter, trips)
+
+    def _sentinel_epoch_boundary(self, summary_losses: dict) -> None:
+        """Epoch-boundary sentinel: acts on the accumulated trip count of a
+        phase summary (``{phase}_nonfinite_trips`` — the log-cadence check
+        only sees dispatches it happens to read). Called for the train
+        summary before validation AND for the val summary before
+        checkpointing (the GD baseline's eval mutates the persisted state,
+        so a poisoned val epoch must also never reach a checkpoint). Under
+        ``skip`` the count is folded into the persisted running total;
+        ``halt``/``rollback`` escalate."""
+        trips = sum(
+            float(value or 0.0)
+            for key, value in summary_losses.items()
+            if key.endswith("_nonfinite_trips")
+        )
+        if trips == 0.0:
+            return
+        if self.on_nonfinite == "halt":
+            raise NonFiniteLossError(
+                f"{int(trips)} non-finite loss(es) in the epoch ending "
+                f"at iteration {self.state['current_iter']} "
+                "(--on_nonfinite=halt); nothing was checkpointed"
+            )
+        if self.on_nonfinite == "rollback":
+            raise _RollbackSignal(self.state["current_iter"], trips)
+        self.state["nonfinite_trips_total"] = (
+            float(self.state.get("nonfinite_trips_total", 0.0)) + trips
+        )
+
+    def _perform_rollback(self, signal_or_iter) -> None:
+        """``rollback`` policy: reload the newest valid checkpoint (or
+        restart from scratch when none exists) and fast-forward the data
+        seed window past the offending batch — the replay trains on fresh
+        episodes instead of deterministically re-hitting the same NaN."""
+        if isinstance(signal_or_iter, _RollbackSignal):
+            trip_iter, trips = signal_or_iter.trip_iter, signal_or_iter.trips
+        else:
+            trip_iter, trips = int(signal_or_iter), 1.0
+        self._rollbacks_this_run += 1
+        if self._rollbacks_this_run > MAX_ROLLBACKS_PER_RUN:
+            raise NonFiniteLossError(
+                f"divergence sentinel rolled back {MAX_ROLLBACKS_PER_RUN} "
+                "times in this run without stabilizing — halting "
+                "(--on_nonfinite=rollback budget exhausted)"
+            )
+        carry_trips = float(self.state.get("nonfinite_trips_total", 0.0)) + trips
+        rollbacks = int(self.state.get("nonfinite_rollbacks", 0)) + 1
+        print(
+            f"WARNING: non-finite meta-loss at iteration {trip_iter}; "
+            f"rolling back to the last valid checkpoint "
+            f"(rollback {self._rollbacks_this_run}/{MAX_ROLLBACKS_PER_RUN})",
+            file=sys.stderr,
+        )
+        if not self._resume_from_latest():
+            self.train_state = self.model.init_state(
+                jax.random.PRNGKey(self.args.seed)
+            )
+            self.state = {
+                "best_val_acc": 0.0,
+                "best_val_iter": 0,
+                "best_epoch": 0,
+                "current_iter": 0,
+            }
+        self.state["nonfinite_trips_total"] = carry_trips
+        self.state["nonfinite_rollbacks"] = rollbacks
+        restored_iter = int(self.state["current_iter"])
+        # Release the abandoned loader's synthesis pool before replacing it
+        # (its prefetch thread parks harmlessly, but the worker pool and
+        # queued batches would otherwise pin memory for the rest of the run).
+        old_pool = getattr(self.data, "_pool", None)
+        if old_pool is not None:
+            old_pool.shutdown(wait=False, cancel_futures=True)
+        # Data consumption resumes PAST the trip point while training
+        # resumes at the checkpoint: the seed windows for
+        # [restored_iter, trip_iter) are never re-served.
+        self.data = self._data_cls(
+            args=self.args, current_iter=max(trip_iter, restored_iter)
+        )
+        self.epoch = restored_iter // int(self.args.total_iter_per_epoch)
+        self.total_losses = {}
+        self._step_times = []
+        self._last_dispatch_t = None
 
     # ------------------------------------------------------------------
     # Observability
@@ -229,7 +567,15 @@ class ExperimentBuilder:
         # dispatch must not measure the val-epoch + checkpoint gap.
         self._last_dispatch_t = None
         if not self._step_times:
-            return {}
+            # STABLE SCHEMA: emit the keys as NaN rather than omitting them.
+            # An epoch with <2 dispatches (a mid-epoch emergency resume, or
+            # K >= total_iter_per_epoch) otherwise writes a CSV row two
+            # columns short of the header and silently misaligns every
+            # column after "epoch" (rows are positional).
+            return {
+                "train_step_time_p50": float("nan"),
+                "train_step_time_p95": float("nan"),
+            }
         times = np.asarray(self._step_times)
         self._step_times = []
         return {
@@ -262,6 +608,9 @@ class ExperimentBuilder:
 
         current_iter += 1
         if current_iter % TRAIN_LOG_EVERY == 0 or current_iter == 1:
+            # Both the print and the sentinel force the same already-computed
+            # device scalars — one sync, shared.
+            self._sentinel_check(losses, current_iter)
             print(
                 f"training iter {current_iter} epoch {self.epoch} -> "
                 + self.build_loss_summary_string(losses),
@@ -282,6 +631,7 @@ class ExperimentBuilder:
             total_losses.setdefault(key, []).append(value)
         current_iter += len(samples)
         if _multi_log_due(current_iter, len(samples)):
+            self._sentinel_check(losses, current_iter)
             print(
                 f"training iter {current_iter} epoch {self.epoch} -> "
                 + self.build_loss_summary_string(losses),
@@ -316,16 +666,13 @@ class ExperimentBuilder:
     # ------------------------------------------------------------------
 
     def save_models(self, model, epoch, state):
-        model.save_model(
-            os.path.join(self.saved_models_filepath, f"train_model_{int(epoch)}"),
-            self.train_state,
-            state,
-        )
-        model.save_model(
-            os.path.join(self.saved_models_filepath, "train_model_latest"),
-            self.train_state,
-            state,
-        )
+        # ONE serialization per epoch: the epoch file is written in full
+        # (device_get + npz) and ``latest`` is published as a
+        # hardlink-or-copy alias of it — previously the identical state was
+        # serialized twice (PERF_NOTES.md "Checkpoint write cost").
+        epoch_path = self._checkpoint_path(int(epoch))
+        model.save_model(epoch_path, self.train_state, state)
+        publish_alias(epoch_path, self._checkpoint_path("latest"))
         print("saved models to", self.saved_models_filepath)
 
     def pack_and_save_metrics(self, start_time, create_summary_csv, train_losses,
@@ -350,8 +697,20 @@ class ExperimentBuilder:
         start_time = time.time()
         print("epoch {} -> {}".format(epoch_summary_losses["epoch"],
                                       epoch_summary_string))
+        # Rows are positional: when resuming an experiment whose CSV was
+        # created by an older build (different metric-key set, e.g. without
+        # train_nonfinite_trips), align the row to the FILE's header —
+        # missing columns stay empty, new keys are dropped — instead of
+        # silently shifting every column after the first mismatch.
+        row = list(epoch_summary_losses.values())
+        summary_csv = os.path.join(self.logs_filepath, "summary_statistics.csv")
+        if os.path.exists(summary_csv):
+            with open(summary_csv) as f:
+                header = f.readline().rstrip("\n").split(",")
+            if header and header != list(epoch_summary_losses.keys()):
+                row = [epoch_summary_losses.get(col, "") for col in header]
         self.summary_statistics_filepath = save_statistics(
-            self.logs_filepath, list(epoch_summary_losses.values())
+            self.logs_filepath, row
         )
         return start_time, state
 
@@ -386,6 +745,10 @@ class ExperimentBuilder:
             for test_sample in self.data.get_test_batches(
                 total_batches=num_batches, augment_images=False
             ):
+                # Preemption boundary for the eval phase: no checkpoint to
+                # write (state holds a RELOADED ensemble model), just a
+                # prompt requeue exit — the phase re-runs in full.
+                self._maybe_emergency_exit(write_checkpoint=False)
                 per_model_per_batch_targets[idx].extend(np.array(test_sample[3]))
                 per_model_per_batch_preds = self.test_evaluation_iteration(
                     val_sample=test_sample,
@@ -417,10 +780,12 @@ class ExperimentBuilder:
     # ------------------------------------------------------------------
 
     def run_experiment(self):
+        self._install_signal_handlers()
         try:
             return self._run_experiment()
         finally:
             self._stop_profiler()
+            self._restore_signal_handlers()
 
     def _run_experiment(self):
         total_iters = int(self.args.total_epochs * self.args.total_iter_per_epoch)
@@ -428,101 +793,128 @@ class ExperimentBuilder:
             self.state["current_iter"] < total_iters
             and not self.args.evaluate_on_test_set_only
         ):
-            buffered = []
-            for train_sample_idx, train_sample in enumerate(
-                self.data.get_train_batches(
-                    total_batches=total_iters - self.state["current_iter"],
-                    augment_images=self.augment_flag,
-                )
-            ):
-                if self._use_multi:
-                    buffered.append(train_sample)
-                    next_iter = self.state["current_iter"] + len(buffered)
-                    # Flush at chunk size or epoch boundary (chunks never
-                    # straddle the validation epoch).
-                    if (
-                        len(buffered) < self.iters_per_dispatch
-                        and next_iter % self.args.total_iter_per_epoch != 0
-                    ):
-                        continue
-                    (self.total_losses,
-                     self.state["current_iter"]) = self.train_iteration_multi(
-                        samples=buffered,
-                        epoch_idx=(self.state["current_iter"]
-                                   / self.args.total_iter_per_epoch),
-                        total_losses=self.total_losses,
-                        current_iter=self.state["current_iter"],
-                    )
-                    buffered = []
-                else:
-                    (self.total_losses,
-                     self.state["current_iter"]) = self.train_iteration(
-                        train_sample=train_sample,
-                        sample_idx=self.state["current_iter"],
-                        epoch_idx=(self.state["current_iter"]
-                                   / self.args.total_iter_per_epoch),
-                        total_losses=self.total_losses,
-                        current_iter=self.state["current_iter"],
-                    )
-
-                if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
-                    train_losses = self.build_summary_dict(
-                        self.total_losses, phase="train"
-                    )
-                    train_losses.update(self._epoch_step_time_stats())
-                    total_losses = {}
-                    num_val_batches = int(
-                        self.args.num_evaluation_tasks / self.args.batch_size
-                    )
-                    for val_sample in self.data.get_val_batches(
-                        total_batches=num_val_batches, augment_images=False
-                    ):
-                        total_losses = self.evaluation_iteration(
-                            val_sample=val_sample, total_losses=total_losses,
-                            phase="val",
-                        )
-                    val_losses = self.build_summary_dict(total_losses, phase="val")
-                    if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
-                        print("Best validation accuracy",
-                              val_losses["val_accuracy_mean"])
-                        self.state["best_val_acc"] = val_losses["val_accuracy_mean"]
-                        self.state["best_val_iter"] = self.state["current_iter"]
-                        self.state["best_epoch"] = int(
-                            self.state["best_val_iter"]
-                            / self.args.total_iter_per_epoch
-                        )
-
-                    self.epoch += 1
-                    self.state = self.merge_two_dicts(
-                        self.merge_two_dicts(self.state, train_losses), val_losses
-                    )
-                    # Metrics are packed BEFORE checkpointing — a deliberate
-                    # fix of the reference's ordering (:350 vs :352), where
-                    # the epoch-N checkpoint misses epoch N's stats row, so a
-                    # resume loses it and silently shifts the
-                    # ensemble's val-stats-index -> checkpoint mapping.
-                    self.start_time, self.state = self.pack_and_save_metrics(
-                        start_time=self.start_time,
-                        create_summary_csv=self.create_summary_csv,
-                        train_losses=train_losses,
-                        val_losses=val_losses,
-                        state=self.state,
-                    )
-                    self.save_models(model=self.model, epoch=self.epoch,
-                                     state=self.state)
-                    self.total_losses = {}
-                    self.epochs_done_in_this_run += 1
-                    save_to_json(
-                        filename=os.path.join(self.logs_filepath,
-                                              "summary_statistics.json"),
-                        dict_to_store=self.state["per_epoch_statistics"],
-                    )
-                    if self.epochs_done_in_this_run >= self.total_epochs_before_pause:
-                        print(
-                            "train_seed {}, val_seed: {}, at pause time".format(
-                                self.data.dataset.seed["train"],
-                                self.data.dataset.seed["val"],
-                            )
-                        )
-                        sys.exit()
+            try:
+                self._train_until_rollback(total_iters)
+            except _RollbackSignal as trip:
+                self._perform_rollback(trip)
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
+
+    def _train_until_rollback(self, total_iters):
+        """One pass of the train loop over a fresh batch generator; unwinds
+        with ``_RollbackSignal`` when the divergence sentinel trips under the
+        ``rollback`` policy (the outer loop reloads and re-enters)."""
+        buffered = []
+        for train_sample_idx, train_sample in enumerate(
+            self.data.get_train_batches(
+                total_batches=total_iters - self.state["current_iter"],
+                augment_images=self.augment_flag,
+            )
+        ):
+            if self._use_multi:
+                buffered.append(train_sample)
+                next_iter = self.state["current_iter"] + len(buffered)
+                # Flush at chunk size or epoch boundary (chunks never
+                # straddle the validation epoch).
+                if (
+                    len(buffered) < self.iters_per_dispatch
+                    and next_iter % self.args.total_iter_per_epoch != 0
+                ):
+                    continue
+                (self.total_losses,
+                 self.state["current_iter"]) = self.train_iteration_multi(
+                    samples=faultinject.poison_batches(
+                        buffered, self.state["current_iter"]
+                    ),
+                    epoch_idx=(self.state["current_iter"]
+                               / self.args.total_iter_per_epoch),
+                    total_losses=self.total_losses,
+                    current_iter=self.state["current_iter"],
+                )
+                buffered = []
+            else:
+                (self.total_losses,
+                 self.state["current_iter"]) = self.train_iteration(
+                    train_sample=faultinject.poison_batch(
+                        train_sample, self.state["current_iter"]
+                    ),
+                    sample_idx=self.state["current_iter"],
+                    epoch_idx=(self.state["current_iter"]
+                               / self.args.total_iter_per_epoch),
+                    total_losses=self.total_losses,
+                    current_iter=self.state["current_iter"],
+                )
+
+            if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
+                train_losses = self.build_summary_dict(
+                    self.total_losses, phase="train"
+                )
+                train_losses.update(self._epoch_step_time_stats())
+                # Epoch-boundary sentinel: runs BEFORE validation and
+                # checkpointing, so a poisoned epoch can neither waste a
+                # val pass (halt/rollback) nor reach a checkpoint.
+                self._sentinel_epoch_boundary(train_losses)
+                total_losses = {}
+                num_val_batches = int(
+                    self.args.num_evaluation_tasks / self.args.batch_size
+                )
+                for val_sample in self.data.get_val_batches(
+                    total_batches=num_val_batches, augment_images=False
+                ):
+                    total_losses = self.evaluation_iteration(
+                        val_sample=val_sample, total_losses=total_losses,
+                        phase="val",
+                    )
+                val_losses = self.build_summary_dict(total_losses, phase="val")
+                # GD's eval mutates the persisted state: check val trips
+                # before best-val tracking and checkpointing too.
+                self._sentinel_epoch_boundary(val_losses)
+                if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
+                    print("Best validation accuracy",
+                          val_losses["val_accuracy_mean"])
+                    self.state["best_val_acc"] = val_losses["val_accuracy_mean"]
+                    self.state["best_val_iter"] = self.state["current_iter"]
+                    self.state["best_epoch"] = int(
+                        self.state["best_val_iter"]
+                        / self.args.total_iter_per_epoch
+                    )
+
+                self.epoch += 1
+                self.state = self.merge_two_dicts(
+                    self.merge_two_dicts(self.state, train_losses), val_losses
+                )
+                # Metrics are packed BEFORE checkpointing — a deliberate
+                # fix of the reference's ordering (:350 vs :352), where
+                # the epoch-N checkpoint misses epoch N's stats row, so a
+                # resume loses it and silently shifts the
+                # ensemble's val-stats-index -> checkpoint mapping.
+                self.start_time, self.state = self.pack_and_save_metrics(
+                    start_time=self.start_time,
+                    create_summary_csv=self.create_summary_csv,
+                    train_losses=train_losses,
+                    val_losses=val_losses,
+                    state=self.state,
+                )
+                self.save_models(model=self.model, epoch=self.epoch,
+                                 state=self.state)
+                self.total_losses = {}
+                self.epochs_done_in_this_run += 1
+                save_to_json(
+                    filename=os.path.join(self.logs_filepath,
+                                          "summary_statistics.json"),
+                    dict_to_store=self.state["per_epoch_statistics"],
+                )
+                if self.epochs_done_in_this_run >= self.total_epochs_before_pause:
+                    print(
+                        "train_seed {}, val_seed: {}, at pause time".format(
+                            self.data.dataset.seed["train"],
+                            self.data.dataset.seed["val"],
+                        )
+                    )
+                    sys.exit()
+
+            # Preemption boundary: AFTER the epoch-boundary block, so a
+            # signal landing on a boundary dispatch still gets its val
+            # epoch + epoch checkpoint + stats row before the exit (a
+            # mid-epoch emergency resume cannot reconstruct those).
+            faultinject.sigterm_due(self.state["current_iter"])
+            self._maybe_emergency_exit()

@@ -14,6 +14,7 @@ import functools
 import math
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -33,6 +34,41 @@ def named_partial(name: str, fn, *args, **kwargs):
     bound.__name__ = name
     bound.__qualname__ = name
     return bound
+
+
+def nonfinite_flag(*values) -> jax.Array:
+    """``0.0`` when every entry of every value is finite, else ``1.0`` —
+    the divergence sentinel's trip signal, computed on-device inside the
+    step program (no host sync; the runtime reads it only at points that
+    already force metrics: log cadence and epoch boundaries)."""
+    ok = jnp.bool_(True)
+    for v in values:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v)))
+    return jnp.logical_not(ok).astype(jnp.float32)
+
+
+def discard_nonfinite_update(flag, new_tree, old_tree):
+    """Sentinel ``skip`` policy, resolved on-device: keeps ``new_tree`` when
+    ``flag`` (from ``nonfinite_flag``) is 0, else ``old_tree``. Selecting
+    inside the step program is what makes ``skip`` compatible with buffer
+    donation — by the time the host could inspect the loss, the pre-dispatch
+    state's buffers have already been donated away."""
+    keep_new = flag == 0.0
+    return jax.tree.map(lambda n, o: jnp.where(keep_new, n, o), new_tree, old_tree)
+
+
+def guard_nonfinite_update(skip: bool, nonfinite, new_state, old_state):
+    """The learners' shared sentinel-``skip`` wiring: when ``skip`` (static,
+    from ``cfg.skip_nonfinite_updates``, TRAIN steps only — eval must not
+    silently drop transitions), a tripped dispatch keeps ``old_state``
+    wholesale while the iteration counter still advances (the LR schedule
+    and data window are host-driven and must stay in step). Both states are
+    NamedTuples with an ``iteration`` field."""
+    if not skip:
+        return new_state
+    return discard_nonfinite_update(nonfinite, new_state, old_state)._replace(
+        iteration=old_state.iteration + 1
+    )
 
 
 def cosine_epoch_lr(

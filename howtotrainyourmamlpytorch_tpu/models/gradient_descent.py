@@ -39,8 +39,10 @@ from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
     decode_images,
+    guard_nonfinite_update,
     make_injected_adam,
     named_partial,
+    nonfinite_flag,
     prepare_batch,
     set_injected_lr,
 )
@@ -73,6 +75,7 @@ class GradientDescentLearner(CheckpointableLearner):
             named_partial(
                 "gd_train_step", self._run_batch,
                 num_steps=cfg.number_of_training_steps_per_iter,
+                training=True,
             ),
             donate_argnums=(0,),
         )
@@ -80,6 +83,7 @@ class GradientDescentLearner(CheckpointableLearner):
             named_partial(
                 "gd_eval_step", self._run_batch,
                 num_steps=cfg.number_of_evaluation_steps_per_iter,
+                training=False,
             ),
             donate_argnums=(0,),
         )
@@ -103,7 +107,8 @@ class GradientDescentLearner(CheckpointableLearner):
         updates, opt_state = self.tx.update(grads, opt_state, theta)
         return optax.apply_updates(theta, updates), opt_state
 
-    def _run_batch(self, state: GDState, batch, *, num_steps: int):
+    def _run_batch(self, state: GDState, batch, *, num_steps: int,
+                   training: bool = True):
         """One meta-iteration: sequentially fine-tune over each task."""
         backbone = self.backbone
         xs_b, xt_b, ys_b, yt_b = batch
@@ -141,15 +146,29 @@ class GradientDescentLearner(CheckpointableLearner):
             )(theta)
             theta, opt_state = self._update(grads, opt_state, theta)
             acc = accuracy(t_logits, yt)
-            return (theta, bn, opt_state), (t_loss, acc, t_logits)
+            return (theta, bn, opt_state), (
+                t_loss, acc, t_logits, optax.global_norm(grads)
+            )
 
-        (theta, bn, opt_state), (t_losses, accs, logits) = lax.scan(
+        (theta, bn, opt_state), (t_losses, accs, logits, grad_norms) = lax.scan(
             task_fn, (state.theta, state.bn_state, state.opt_state),
             (xs_b, ys_b, xt_b, yt_b),
         )
         new_state = GDState(theta, bn, opt_state, state.iteration + 1)
+        # Divergence sentinel: the trip check covers EVERY task's target loss
+        # AND update-gradient norm (the reported metric is last-task-only, so
+        # a mid-batch NaN would otherwise hide while still poisoning the
+        # shared weights; a NaN inner-step grad surfaces via the target loss
+        # computed from the poisoned fast weights). The skip select is
+        # TRAIN-only: eval fine-tunes by design and must not silently drop a
+        # batch's state transition.
+        nonfinite = nonfinite_flag(t_losses, grad_norms)
+        new_state = guard_nonfinite_update(
+            training and self.cfg.skip_nonfinite_updates, nonfinite,
+            new_state, state,
+        )
         # Last task's metrics — reference behavior (gradient_descent.py:122).
-        metrics = dict(loss=t_losses[-1], accuracy=accs[-1])
+        metrics = dict(loss=t_losses[-1], accuracy=accs[-1], nonfinite=nonfinite)
         return new_state, metrics, logits
 
     # -- trainer contract ------------------------------------------------
@@ -166,6 +185,7 @@ class GradientDescentLearner(CheckpointableLearner):
         losses = {
             "loss": metrics["loss"],
             "accuracy": metrics["accuracy"],
+            "nonfinite": metrics["nonfinite"],
             "learning_rate": lr,
         }
         return new_state, losses
@@ -178,5 +198,11 @@ class GradientDescentLearner(CheckpointableLearner):
         losses = {
             "loss": metrics["loss"],
             "accuracy": metrics["accuracy"],
+            # Unlike the pure MAML/matching evals, this eval MUTATES the
+            # persisted state — a NaN val batch poisons train_state, so the
+            # sentinel must see the trip (the builder checks val trips at
+            # the epoch boundary before checkpointing). The on-device skip
+            # select stays train-only by design.
+            "nonfinite": metrics["nonfinite"],
         }
         return new_state, losses, logits

@@ -51,7 +51,9 @@ from .common import (
     WireCodec,
     cosine_epoch_lr,
     decode_images,
+    guard_nonfinite_update,
     named_partial,
+    nonfinite_flag,
     prepare_batch,
     set_injected_lr,
 )
@@ -92,6 +94,12 @@ class MAMLConfig:
     # BN learnability (torch requires_grad equivalents)
     learnable_bn_gamma: bool = True
     learnable_bn_beta: bool = True
+
+    # Divergence sentinel, ``skip`` policy (``--on_nonfinite=skip``): when a
+    # dispatch's meta-loss goes non-finite, discard that update on-device
+    # (retaining the pre-dispatch state) instead of poisoning the params.
+    # The trip is reported through the ``nonfinite`` metric either way.
+    skip_nonfinite_updates: bool = False
 
     # TPU-specific
     remat_inner_steps: bool = True
@@ -318,6 +326,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         losses = {
             "loss": metrics["loss"],
             "accuracy": metrics["accuracy"],
+            "nonfinite": metrics["nonfinite"],
         }
         msl_vector = per_step_loss_importance(
             epoch,
@@ -619,7 +628,17 @@ class MAMLFewShotLearner(CheckpointableLearner):
             opt_state=opt_state,
             iteration=state.iteration + 1,
         )
-        metrics = dict(loss=loss, accuracy=jnp.mean(aux["accuracy"]))
+        # Divergence sentinel: on-device finite-check of the meta-loss AND
+        # the meta-gradient norm — the classic second-order overflow mode is
+        # an inf/NaN meta-grad under a still-finite loss, which poisons the
+        # params while a loss-only check reads clean.
+        nonfinite = nonfinite_flag(loss, optax.global_norm(grads))
+        new_state = guard_nonfinite_update(
+            self.cfg.skip_nonfinite_updates, nonfinite, new_state, state
+        )
+        metrics = dict(
+            loss=loss, accuracy=jnp.mean(aux["accuracy"]), nonfinite=nonfinite
+        )
         return new_state, metrics
 
     def _evaluation_step(self, state: TrainState, batch, importance, *, final_only=False):
@@ -696,6 +715,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         losses = {
             "loss": metrics["loss"],
             "accuracy": metrics["accuracy"],
+            "nonfinite": metrics["nonfinite"],
         }
         msl_vector = per_step_loss_importance(
             epoch,

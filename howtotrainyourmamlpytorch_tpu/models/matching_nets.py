@@ -48,8 +48,10 @@ from .common import (
     CheckpointableLearner,
     cosine_epoch_lr,
     decode_images,
+    guard_nonfinite_update,
     make_injected_adam,
     named_partial,
+    nonfinite_flag,
     prepare_batch,
     set_injected_lr,
 )
@@ -182,16 +184,27 @@ class MatchingNetsLearner(CheckpointableLearner):
                 )(theta, bn, xs, ys, xt, yt)
                 updates, opt_state = self.tx.update(grads, opt_state, theta)
                 theta = optax.apply_updates(theta, updates)
+                grad_norm = optax.global_norm(grads)
             else:
                 loss, (acc, preds, bn_new) = self._task_loss(theta, bn, xs, ys, xt, yt)
                 del bn_new  # eval discards running stats (restore semantics)
-            return (theta, bn, opt_state), (loss, acc, preds)
+                grad_norm = jnp.zeros((), jnp.float32)
+            return (theta, bn, opt_state), (loss, acc, preds, grad_norm)
 
-        (theta, bn, opt_state), (losses, accs, preds) = lax.scan(
+        (theta, bn, opt_state), (losses, accs, preds, grad_norms) = lax.scan(
             task_fn, (state.theta, state.bn_state, state.opt_state),
             (xs_b, ys_b, xt_b, yt_b),
         )
         new_state = MatchingNetsState(theta, bn, opt_state, state.iteration + 1)
+        # Divergence sentinel over every task's loss and update-grad norm
+        # (under parity_bug the reported metric is last-task-only and would
+        # hide mid-batch NaNs; a finite loss with an inf grad would poison
+        # theta while reading clean).
+        nonfinite = nonfinite_flag(losses, grad_norms)
+        new_state = guard_nonfinite_update(
+            training and self.cfg.skip_nonfinite_updates, nonfinite,
+            new_state, state,
+        )
         if self.parity_bug:
             # The reference re-initializes its metric lists INSIDE the task
             # loop (matching_nets.py:92-97), so it reports only the LAST
@@ -200,6 +213,7 @@ class MatchingNetsLearner(CheckpointableLearner):
             metrics = dict(loss=losses[-1], accuracy=accs[-1])
         else:
             metrics = dict(loss=jnp.mean(losses), accuracy=jnp.mean(accs))
+        metrics["nonfinite"] = nonfinite
         return new_state, metrics, preds
 
     # -- trainer contract ------------------------------------------------
@@ -216,6 +230,7 @@ class MatchingNetsLearner(CheckpointableLearner):
         losses = {
             "loss": metrics["loss"],
             "accuracy": metrics["accuracy"],
+            "nonfinite": metrics["nonfinite"],
             "learning_rate": lr,
         }
         return new_state, losses

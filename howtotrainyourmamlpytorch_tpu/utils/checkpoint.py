@@ -10,55 +10,323 @@ the experiment-state dict (``best_val_acc``, ``current_iter``,
 Format: a NumPy ``.npz`` archive of the pytree's leaves in flatten order
 (the tree *structure* is code-defined and rebuilt from a template state on
 load, so files stay engine-agnostic and inspectable) with the experiment
-state embedded as a JSON string. Checkpoints are written atomically
-(temp file + rename) so a preemption mid-save never corrupts ``latest`` —
-the fault-tolerance contract the reference gets from kill-and-rerun resume
-(``README.md:91-93``).
+state embedded as a JSON string.
+
+Fault-tolerance contract (the reference's whole story is kill-and-rerun
+resume, ``README.md:91-93`` — this layer makes that mechanical):
+
+* writes are atomic (temp file + rename), so a preemption mid-save never
+  corrupts ``latest``, and transient I/O errors (disk-full, flaky NFS) are
+  retried with exponential backoff before surfacing;
+* every archive embeds an integrity manifest (schema version, leaf count,
+  per-leaf CRC32, tree-structure fingerprint); ``load_checkpoint`` verifies
+  it and raises a typed ``CheckpointCorruptError`` instead of an opaque
+  ``zipfile`` error, so resume paths can quarantine the file and fall back
+  to an older checkpoint;
+* structural mismatches (a checkpoint from a different config/architecture)
+  fail fast with ``ValueError`` — never a silent load-by-truncation;
+* the ``latest`` pointer is published as a hardlink-or-copy alias of the
+  epoch file (``publish_alias``) — one serialization per epoch, not two.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import time
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
+from . import faultinject
+
 Tree = Any
 
 _EXPERIMENT_KEY = "__experiment_state__"
+_MANIFEST_KEY = "__manifest__"
+
+#: Bump when the archive layout changes incompatibly. Loaders refuse newer
+#: schemas with a typed error instead of misreading them.
+SCHEMA_VERSION = 1
+
+#: Retry budgets: total attempts per call, with exponential backoff between
+#: them (transient disk-full / NFS hiccups). Reads retry too: a flaky-NFS
+#: ``EIO`` at resume time must not masquerade as corruption — the resume
+#: fallback would quarantine perfectly good checkpoints.
+WRITE_RETRIES = 3
+READ_RETRIES = 3
+WRITE_BACKOFF_S = 0.05
 
 
-def save_checkpoint(filepath: str, state_tree: Tree, experiment_state: dict) -> str:
-    """Writes leaves + experiment state to ``filepath`` (no extension added).
+class CheckpointError(Exception):
+    """Base class for typed checkpoint failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file is unreadable or fails integrity verification (truncation,
+    bit-rot, torn write). Resume paths may quarantine it and fall back to an
+    older checkpoint; a config/architecture mismatch is NOT this error."""
+
+
+def _tree_fingerprint(tree: Tree) -> int:
+    """CRC32 of the tree's canonical key-path encoding.
+
+    Built from the path-entry ATTRIBUTES (``DictKey.key``,
+    ``SequenceKey.idx``, ...) rather than ``str(treedef)`` — treedef repr is
+    not a stability contract across jax versions, and a formatting change
+    there must not make every pre-upgrade checkpoint resume-refuse as an
+    architecture mismatch."""
+    from jax.tree_util import (
+        DictKey,
+        FlattenedIndexKey,
+        GetAttrKey,
+        SequenceKey,
+        tree_flatten_with_path,
+    )
+
+    paths_and_leaves, _ = tree_flatten_with_path(tree)
+    parts = []
+    for path, _leaf in paths_and_leaves:
+        for entry in path:
+            if isinstance(entry, DictKey):
+                parts.append(f"d:{entry.key}")
+            elif isinstance(entry, SequenceKey):
+                parts.append(f"s:{entry.idx}")
+            elif isinstance(entry, GetAttrKey):
+                parts.append(f"a:{entry.name}")
+            elif isinstance(entry, FlattenedIndexKey):
+                parts.append(f"i:{entry.key}")
+            else:  # exotic custom node: fall back to repr (best effort)
+                parts.append(f"?:{entry!r}")
+        parts.append("|")
+    return zlib.crc32(";".join(parts).encode())
+
+
+def _leaf_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def save_checkpoint(
+    filepath: str,
+    state_tree: Tree,
+    experiment_state: dict,
+    *,
+    retries: int = WRITE_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> str:
+    """Writes leaves + experiment state + integrity manifest to ``filepath``
+    (no extension added), atomically, retrying transient ``OSError`` up to
+    ``retries`` total attempts with exponential backoff.
 
     Device arrays are fetched with ONE batched ``jax.device_get`` — per-leaf
     ``np.asarray`` costs a full device round trip each (~10 s per save
     through the axon tunnel vs ~0.2 s batched)."""
-    leaves = jax.device_get(jax.tree.leaves(state_tree))
-    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
-    arrays[_EXPERIMENT_KEY] = np.frombuffer(
-        json.dumps(experiment_state, default=float).encode(), dtype=np.uint8
+    host_leaves, treedef = jax.tree.flatten(state_tree)
+    host_leaves = jax.device_get(host_leaves)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(host_leaves)}
+    exp_bytes = json.dumps(experiment_state, default=float).encode()
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "leaf_count": len(host_leaves),
+        "leaf_crc32": [_leaf_crc(a) for a in arrays.values()],
+        "tree_crc32": _tree_fingerprint(state_tree),
+        "experiment_crc32": zlib.crc32(exp_bytes),
+    }
+    arrays[_EXPERIMENT_KEY] = np.frombuffer(exp_bytes, dtype=np.uint8)
+    arrays[_MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8
     )
+
     tmp = filepath + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-    os.replace(tmp, filepath)
+    last_error: OSError | None = None
+    for attempt in range(max(int(retries), 1)):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            faultinject.checkpoint_write_attempt(filepath)
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, filepath)
+            last_error = None
+            break
+        except OSError as exc:
+            last_error = exc
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if last_error is not None:
+        raise last_error
+    faultinject.checkpoint_written(filepath)
     return filepath
 
 
-def load_checkpoint(filepath: str, template_tree: Tree) -> tuple[Tree, dict]:
-    """Restores ``(state_tree, experiment_state)``; leaf order/structure come
-    from ``template_tree`` (e.g. a fresh ``learner.init_state(key)``)."""
+def publish_alias(
+    src: str,
+    dst: str,
+    *,
+    retries: int = WRITE_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> str:
+    """Publishes ``dst`` as an alias of the existing checkpoint ``src`` via
+    hardlink-or-copy + atomic ``os.replace`` — the ``latest`` pointer costs
+    zero re-serialization (previously a second full ``device_get`` + npz
+    write per epoch). Hardlinking is safe against future writes because
+    ``save_checkpoint`` always publishes a NEW inode via rename and never
+    mutates an existing file in place. Transient ``OSError`` is retried
+    with the same budget as ``save_checkpoint`` — the retry contract covers
+    BOTH halves of the epoch checkpoint publish."""
+    tmp = dst + ".alias.tmp"
+    last_error: OSError | None = None
+    for attempt in range(max(int(retries), 1)):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            faultinject.checkpoint_write_attempt(dst)
+            try:
+                os.remove(tmp)
+            except FileNotFoundError:
+                pass
+            try:
+                os.link(src, tmp)
+            except OSError:  # cross-device layout or no-hardlink filesystem
+                shutil.copyfile(src, tmp)
+            os.replace(tmp, dst)
+            last_error = None
+            break
+        except OSError as exc:
+            last_error = exc
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if last_error is not None:
+        raise last_error
+    faultinject.checkpoint_written(dst)
+    return dst
+
+
+def _read_archive(filepath: str):
+    """Fully materializes ``(leaf_arrays, exp_bytes, manifest_or_None)``.
+    Reading every member forces the zip layer's own per-member CRC checks,
+    so truncation and bit-flips surface here as exceptions."""
     with np.load(filepath) as archive:
-        experiment_state = json.loads(bytes(archive[_EXPERIMENT_KEY]).decode())
-        template_leaves, treedef = jax.tree.flatten(template_tree)
-        n = len(template_leaves)
-        loaded = [archive[f"leaf_{i}"] for i in range(n)]
+        files = set(archive.files)
+        manifest = None
+        if _MANIFEST_KEY in files:
+            manifest = json.loads(bytes(archive[_MANIFEST_KEY]).decode())
+        exp_bytes = bytes(archive[_EXPERIMENT_KEY])
+        leaves = {
+            name: archive[name] for name in files if name.startswith("leaf_")
+        }
+    return leaves, exp_bytes, manifest
+
+
+def _verify_manifest(filepath: str, manifest: dict, leaves: dict, exp_bytes: bytes):
+    schema = int(manifest.get("schema", -1))
+    if schema > SCHEMA_VERSION:
+        raise CheckpointError(
+            f"{filepath}: written by checkpoint schema {schema}, this build "
+            f"reads up to {SCHEMA_VERSION} — refusing to misread it"
+        )
+    leaf_count = int(manifest["leaf_count"])
+    crcs = manifest["leaf_crc32"]
+    if len(leaves) != leaf_count or len(crcs) != leaf_count:
+        raise CheckpointCorruptError(
+            f"{filepath}: archive holds {len(leaves)} leaf members but the "
+            f"manifest recorded {leaf_count} (truncated or torn write)"
+        )
+    if zlib.crc32(exp_bytes) != int(manifest["experiment_crc32"]):
+        raise CheckpointCorruptError(
+            f"{filepath}: experiment-state CRC mismatch (corrupt archive)"
+        )
+    for i, expected in enumerate(crcs):
+        arr = leaves.get(f"leaf_{i}")
+        if arr is None:
+            raise CheckpointCorruptError(
+                f"{filepath}: leaf {i} missing from archive (truncated write)"
+            )
+        if _leaf_crc(arr) != int(expected):
+            raise CheckpointCorruptError(
+                f"{filepath}: leaf {i} CRC mismatch (bit-rot or torn write)"
+            )
+
+
+def load_checkpoint(
+    filepath: str,
+    template_tree: Tree,
+    *,
+    retries: int = READ_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> tuple[Tree, dict]:
+    """Restores ``(state_tree, experiment_state)``; leaf order/structure come
+    from ``template_tree`` (e.g. a fresh ``learner.init_state(key)``).
+
+    Raises ``CheckpointCorruptError`` for integrity failures of the file
+    itself (truncation, bit-rot, bad archive — callers may quarantine and
+    fall back to an older checkpoint) and ``ValueError`` for structural
+    mismatches — wrong leaf count, tree fingerprint, or leaf shape, i.e. a
+    checkpoint from a different config/architecture. Transient read-side
+    ``OSError`` (flaky NFS, EIO) is retried with backoff and then surfaced
+    as plain ``CheckpointError`` — NOT the corrupt subtype, so a brief I/O
+    outage at resume time can never cascade-quarantine healthy checkpoints.
+    Archives without a manifest (pre-schema legacy files) load with the
+    structural checks only."""
+    template_leaves, treedef = jax.tree.flatten(template_tree)
+    n_template = len(template_leaves)
+    last_io_error: OSError | None = None
+    for attempt in range(max(int(retries), 1)):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            leaves, exp_bytes, manifest = _read_archive(filepath)
+            if manifest is not None:
+                _verify_manifest(filepath, manifest, leaves, exp_bytes)
+            experiment_state = json.loads(exp_bytes.decode())
+            break
+        except CheckpointError:
+            raise
+        except FileNotFoundError as exc:
+            # Deterministic, not transient: the named checkpoint is gone.
+            raise CheckpointCorruptError(
+                f"{filepath}: checkpoint file does not exist"
+            ) from exc
+        except OSError as exc:  # transient I/O: retry, never quarantine
+            last_io_error = exc
+        except Exception as exc:  # zipfile/EOFError/KeyError/json errors
+            raise CheckpointCorruptError(
+                f"{filepath}: unreadable checkpoint archive "
+                f"({type(exc).__name__}: {exc})"
+            ) from exc
+    else:
+        raise CheckpointError(
+            f"{filepath}: read failed {max(int(retries), 1)} times "
+            f"({type(last_io_error).__name__}: {last_io_error}) — transient "
+            "I/O failure, not corruption; refusing to quarantine"
+        ) from last_io_error
+
+    if len(leaves) != n_template:
+        raise ValueError(
+            f"{filepath}: checkpoint has {len(leaves)} leaves but the "
+            f"template state has {n_template} — config/architecture mismatch "
+            "(refusing to load by truncation)"
+        )
+    if manifest is not None and int(manifest["tree_crc32"]) != _tree_fingerprint(
+        template_tree
+    ):
+        raise ValueError(
+            f"{filepath}: tree-structure fingerprint mismatch — the "
+            "checkpoint was written for a different state structure "
+            "(config/architecture change?)"
+        )
+
     restored = []
-    for i, (tmpl, leaf) in enumerate(zip(template_leaves, loaded)):
+    for i, tmpl in enumerate(template_leaves):
         tmpl_arr = np.asarray(tmpl)
+        leaf = leaves[f"leaf_{i}"]
         if tmpl_arr.shape != leaf.shape:
             raise ValueError(
                 f"checkpoint leaf {i} shape {leaf.shape} != expected"

@@ -1,0 +1,176 @@
+"""Deterministic fault injection for the fault-tolerance runtime.
+
+The recovery paths in ``utils/checkpoint.py`` and ``experiment_builder.py``
+(checkpoint-integrity fallback, write retry, preemption-safe shutdown, the
+divergence sentinel) are only trustworthy if every one of them is exercised
+end-to-end — failures must be mechanical and tested, not archaeological.
+This module provides the failure points those tests drive:
+
+* ``truncate_checkpoint_at`` — truncate the NEXT published checkpoint file
+  at byte N (bit-rot / torn-write corruption of a file that passed the
+  atomic rename);
+* ``fail_next_writes`` — raise ``OSError`` (``ENOSPC``) on the next K
+  checkpoint write attempts (disk-full / flaky NFS);
+* ``nan_at_iter`` — poison the train batch consumed by iteration I with
+  NaNs, so the meta-loss goes non-finite through the real compute path
+  (float image wire only: the uint8 codec clips NaNs away);
+* ``sigterm_at_iter`` — deliver ``SIGTERM`` to this process right after
+  iteration I's dispatch completes (TPU preemption).
+
+Activation is programmatic (``activate(FaultPlan(...))`` from tests) or via
+the environment: ``MAML_FAULTS="nan_at_iter=40,sigterm_at_iter=120"``
+(comma/semicolon-separated ``key=int`` pairs), read once on first use so a
+launcher can inject faults into an unmodified training command. Every fault
+is one-shot and consumed faults are appended to ``events`` for assertions.
+All hooks are cheap no-ops (one global ``None`` check) when no plan is
+active — the production path pays nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import os
+import re
+import signal
+
+import numpy as np
+
+ENV_VAR = "MAML_FAULTS"
+
+#: Audit log of fired faults (``"write-fail:…"``, ``"truncate:…"``,
+#: ``"nan:…"``, ``"sigterm:…"``), cleared by ``activate``/``deactivate``.
+events: list[str] = []
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One-shot failure points; ``None``/``0`` means inactive."""
+
+    truncate_checkpoint_at: int | None = None
+    fail_next_writes: int = 0
+    nan_at_iter: int | None = None
+    sigterm_at_iter: int | None = None
+
+
+_UNSET = object()  # env not yet consulted
+_plan: FaultPlan | None | object = _UNSET
+
+
+def _plan_from_env() -> FaultPlan | None:
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        return None
+    plan = FaultPlan()
+    fields = {f.name for f in dataclasses.fields(FaultPlan)}
+    for part in re.split(r"[;,]", spec):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key = key.strip()
+        if not sep or key not in fields:
+            raise ValueError(
+                f"{ENV_VAR}: unknown fault {part!r}; expected key=int with "
+                f"key in {sorted(fields)}"
+            )
+        setattr(plan, key, int(value))
+    return plan
+
+
+def _active() -> FaultPlan | None:
+    global _plan
+    if _plan is _UNSET:
+        _plan = _plan_from_env()
+    return _plan  # type: ignore[return-value]
+
+
+def current_plan() -> FaultPlan | None:
+    """The active plan (env-resolved on first call), or None."""
+    return _active()
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    """Installs ``plan`` (overriding any env plan) and clears ``events``."""
+    global _plan
+    _plan = plan
+    events.clear()
+    return plan
+
+
+def deactivate() -> None:
+    """Removes any active plan; the env var is NOT re-read (use ``reset``)."""
+    global _plan
+    _plan = None
+    events.clear()
+
+
+def reset() -> None:
+    """Back to the pristine state: next hook call re-reads ``MAML_FAULTS``."""
+    global _plan
+    _plan = _UNSET
+    events.clear()
+
+
+# ---------------------------------------------------------------------------
+# Failure points
+# ---------------------------------------------------------------------------
+
+
+def checkpoint_write_attempt(filepath: str) -> None:
+    """Called by ``save_checkpoint`` before each write attempt; raises the
+    injected transient I/O error while ``fail_next_writes`` > 0."""
+    plan = _active()
+    if plan is None or plan.fail_next_writes <= 0:
+        return
+    plan.fail_next_writes -= 1
+    events.append(f"write-fail:{os.path.basename(filepath)}")
+    raise OSError(
+        errno.ENOSPC, "faultinject: injected checkpoint write failure", filepath
+    )
+
+
+def checkpoint_written(filepath: str) -> None:
+    """Called after a checkpoint file is published (write or alias); applies
+    the one-shot ``truncate_checkpoint_at`` corruption."""
+    plan = _active()
+    if plan is None or plan.truncate_checkpoint_at is None:
+        return
+    n = plan.truncate_checkpoint_at
+    plan.truncate_checkpoint_at = None
+    with open(filepath, "r+b") as f:
+        f.truncate(n)
+    events.append(f"truncate:{os.path.basename(filepath)}@{n}")
+
+
+def poison_batch(sample, current_iter: int):
+    """Returns ``sample`` with NaN target images when ``current_iter`` is the
+    planned ``nan_at_iter`` (0-based index of the iteration consuming it)."""
+    plan = _active()
+    if plan is None or plan.nan_at_iter is None or current_iter != plan.nan_at_iter:
+        return sample
+    plan.nan_at_iter = None
+    events.append(f"nan:{current_iter}")
+    xs, xt, ys, yt, seed = sample
+    xt = np.full_like(np.asarray(xt, dtype=np.float32), np.nan)
+    return (xs, xt, ys, yt, seed)
+
+
+def poison_batches(samples, first_iter: int):
+    """Multi-dispatch form: element j of ``samples`` feeds iteration
+    ``first_iter + j``."""
+    if _active() is None:
+        return samples
+    return [poison_batch(s, first_iter + j) for j, s in enumerate(samples)]
+
+
+def sigterm_due(iters_done: int) -> None:
+    """Delivers SIGTERM to this process once ``iters_done`` reaches the
+    planned ``sigterm_at_iter`` (count of completed iterations)."""
+    plan = _active()
+    if plan is None or plan.sigterm_at_iter is None:
+        return
+    if iters_done >= plan.sigterm_at_iter:
+        plan.sigterm_at_iter = None
+        events.append(f"sigterm:{iters_done}")
+        os.kill(os.getpid(), signal.SIGTERM)

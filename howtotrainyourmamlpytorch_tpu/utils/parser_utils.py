@@ -156,6 +156,17 @@ def get_parser() -> argparse.ArgumentParser:
     add("--check_tracer_leaks", type=str, default="False",
         help="jax_check_tracer_leaks: raise when a tracer escapes its "
              "trace (the silent-closure-capture bug class; slow)")
+    # Divergence sentinel policy (experiment_builder + models/common):
+    # MAML's second-order meta-gradients can go non-finite (the instability
+    # MAML++ exists to tame); this decides what the runtime does when the
+    # per-dispatch meta-loss trips the on-device finite-check.
+    add("--on_nonfinite", type=str, default="halt",
+        choices=["halt", "skip", "rollback"],
+        help="halt: raise a typed NonFiniteLossError before anything is "
+             "checkpointed; skip: discard the poisoned update on-device and "
+             "keep training; rollback: reload the last valid checkpoint and "
+             "fast-forward the data seed window past the offending batch. "
+             "Trips are counted in the train metrics either way")
     add("--resnet_widths", nargs="+", type=int, default=None,
         help="4 stage widths for architecture_name=resnet12 (default "
              "cnn_num_filters x 1/2/4/8; MetaOptNet uses 64 160 320 640)")
@@ -318,6 +329,9 @@ def args_to_maml_config(args):
         clip_grad_value=10.0 if "imagenet" in args.dataset_name.lower() else None,
         learnable_bn_gamma=bool(args.learnable_bn_gamma),
         learnable_bn_beta=bool(args.learnable_bn_beta),
+        skip_nonfinite_updates=(
+            str(getattr(args, "on_nonfinite", "halt")).lower() == "skip"
+        ),
         compute_dtype=getattr(args, "compute_dtype", "float32"),
         wire_codec=wire_codec_for(args),
     )

@@ -14,9 +14,16 @@ import json
 import os
 
 
-def save_to_json(filename: str, dict_to_store) -> None:
-    with open(os.path.abspath(filename), "w") as f:
-        json.dump(dict_to_store, f)
+def save_to_json(filename: str, dict_to_store, default=None) -> None:
+    """Atomic JSON write (temp file + ``os.replace``) — the same contract
+    ``save_checkpoint`` honors. The previous truncate-then-write destroyed
+    ``summary_statistics.json`` / ``experiment_log.json`` permanently on any
+    crash mid-dump."""
+    path = os.path.abspath(filename)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict_to_store, f, default=default)
+    os.replace(tmp, path)
 
 
 def load_from_json(filename: str):
@@ -74,8 +81,7 @@ def create_json_experiment_log(
     timestamp = datetime.datetime.now().timestamp()
     summary["experiment_status"] = [(timestamp, "initialization")]
     summary["experiment_initialization_time"] = timestamp
-    with open(os.path.abspath(summary_filename), "w") as f:
-        json.dump(summary, f, default=str)
+    save_to_json(summary_filename, summary, default=str)
 
 
 def update_json_experiment_log_dict(

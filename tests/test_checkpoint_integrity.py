@@ -1,0 +1,272 @@
+"""Checkpoint integrity layer: manifest verification, typed corruption
+errors, structural fail-fast, write retry with backoff, and the
+hardlink-alias ``latest`` publisher (ISSUE 3 tentpole, pillars 1 + 4).
+
+These are codec-level tests on small plain pytrees — the end-to-end
+recovery paths through ``ExperimentBuilder`` live in
+``tests/test_faultinject.py``."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.utils import faultinject
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointError,
+    SCHEMA_VERSION,
+    _EXPERIMENT_KEY,
+    _MANIFEST_KEY,
+    load_checkpoint,
+    publish_alias,
+    save_checkpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.deactivate()
+    yield
+    faultinject.reset()
+
+
+def _tree(seed=0, n=3, size=7):
+    rng = np.random.RandomState(seed)
+    return {
+        "params": [rng.rand(size, 2).astype(np.float32) for _ in range(n)],
+        "count": np.int32(seed),
+    }
+
+
+def _save(path, seed=0, exp=None):
+    save_checkpoint(str(path), _tree(seed), exp or {"current_iter": seed})
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# Manifest round-trip + verification
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_embedded_and_roundtrip(tmp_path):
+    path = _save(tmp_path / "ckpt", seed=3)
+    with np.load(path) as archive:
+        manifest = json.loads(bytes(archive[_MANIFEST_KEY]).decode())
+    assert manifest["schema"] == SCHEMA_VERSION
+    assert manifest["leaf_count"] == 4  # 3 params + count
+    assert len(manifest["leaf_crc32"]) == 4
+    restored, exp = load_checkpoint(path, _tree(0))
+    assert exp == {"current_iter": 3}
+    for a, b in zip(
+        restored["params"] + [restored["count"]],
+        _tree(3)["params"] + [_tree(3)["count"]],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_truncated_archive_is_typed_corrupt(tmp_path):
+    path = _save(tmp_path / "ckpt")
+    size = os.path.getsize(path)
+    for cut in (0, 10, size // 2, size - 3):
+        with open(path, "r+b") as f:
+            f.truncate(cut)
+        with pytest.raises(CheckpointCorruptError):
+            load_checkpoint(path, _tree(0))
+        _save(tmp_path / "ckpt")  # restore for the next cut
+
+
+def test_bitflip_in_leaf_data_is_typed_corrupt(tmp_path):
+    """Flips a byte inside actual array data (located by its byte pattern —
+    flips in zip/npy metadata padding are semantically inert and rightly
+    ignored): either the zip member CRC or the manifest leaf CRC must
+    catch it as typed corruption."""
+    path = str(tmp_path / "ckpt")
+    leaf = np.full((64,), 1.2345678, np.float32)
+    save_checkpoint(path, {"a": leaf}, {"current_iter": 0})
+    with open(path, "rb") as f:
+        blob = f.read()
+    offset = blob.find(leaf.tobytes())
+    assert offset > 0  # npz stores uncompressed, the raw bytes must exist
+    with open(path, "r+b") as f:
+        f.seek(offset + 17)
+        byte = f.read(1)
+        f.seek(offset + 17)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path, {"a": leaf})
+
+
+def test_missing_file_is_typed_corrupt(tmp_path):
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(str(tmp_path / "nope"), _tree(0))
+
+
+def test_newer_schema_refused_without_fallback(tmp_path):
+    path = _save(tmp_path / "ckpt")
+    with np.load(path) as archive:
+        arrays = {k: archive[k] for k in archive.files}
+    manifest = json.loads(bytes(arrays[_MANIFEST_KEY]).decode())
+    manifest["schema"] = SCHEMA_VERSION + 1
+    arrays[_MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8
+    )
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    with pytest.raises(CheckpointError) as err:
+        load_checkpoint(path, _tree(0))
+    # NOT the corrupt subtype: resume must not quarantine a future-schema
+    # file, the build is simply too old to read it.
+    assert not isinstance(err.value, CheckpointCorruptError)
+
+
+# ---------------------------------------------------------------------------
+# Structural fail-fast (satellite: no more load-by-truncation)
+# ---------------------------------------------------------------------------
+
+
+def test_leaf_count_mismatch_fails_fast_both_directions(tmp_path):
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, _tree(0, n=3), {})
+    with pytest.raises(ValueError, match="leaves"):
+        load_checkpoint(path, _tree(0, n=2))  # template smaller: was silent!
+    with pytest.raises(ValueError, match="leaves"):
+        load_checkpoint(path, _tree(0, n=5))  # template larger
+
+
+def test_legacy_archive_without_manifest_still_loads(tmp_path):
+    """Pre-schema files (no manifest member) load with structural checks
+    only — kill-and-rerun resume across this PR keeps working."""
+    import jax
+
+    path = str(tmp_path / "legacy")
+    tree = _tree(4)
+    leaves = jax.tree.leaves(tree)  # flatten order = sorted dict keys
+    arrays = {f"leaf_{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    arrays[_EXPERIMENT_KEY] = np.frombuffer(
+        json.dumps({"current_iter": 9}).encode(), dtype=np.uint8
+    )
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    restored, exp = load_checkpoint(path, _tree(0))
+    assert exp["current_iter"] == 9
+    for a, b in zip(jax.tree.leaves(restored), leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ... but a legacy archive with MORE leaves than the template no longer
+    # "loads" by dropping the excess.
+    arrays["leaf_4"] = np.zeros(3, np.float32)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    with pytest.raises(ValueError, match="leaves"):
+        load_checkpoint(path, _tree(0))
+
+
+def test_tree_fingerprint_mismatch_fails_fast(tmp_path):
+    """Same leaf count and shapes, different tree structure: the manifest
+    fingerprint refuses the silent positional remap."""
+    path = str(tmp_path / "ckpt")
+    x = np.arange(4, dtype=np.float32)
+    save_checkpoint(path, {"a": x, "b": x + 1}, {})
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_checkpoint(path, {"c": [x, x]})
+
+
+def test_shape_mismatch_still_valueerror(tmp_path):
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, _tree(0, size=7), {})
+    with pytest.raises(ValueError, match="shape"):
+        load_checkpoint(path, _tree(0, size=9))
+
+
+# ---------------------------------------------------------------------------
+# Write retry + backoff (pillar 1 / acceptance d)
+# ---------------------------------------------------------------------------
+
+
+def test_write_retry_below_budget_succeeds(tmp_path):
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=2))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, _tree(5), {"current_iter": 5}, backoff_s=0.01)
+    assert faultinject.events.count("write-fail:ckpt") == 2
+    _, exp = load_checkpoint(path, _tree(0))
+    assert exp["current_iter"] == 5
+
+
+def test_write_retry_above_budget_raises_and_keeps_old_file(tmp_path):
+    path = _save(tmp_path / "ckpt", seed=1)
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=99))
+    with pytest.raises(OSError, match="faultinject"):
+        save_checkpoint(path, _tree(2), {"current_iter": 2}, backoff_s=0.01)
+    faultinject.deactivate()
+    assert not os.path.exists(path + ".tmp")  # tmp cleaned up
+    _, exp = load_checkpoint(path, _tree(0))  # previous file intact
+    assert exp["current_iter"] == 1
+
+
+def test_transient_read_error_retries_then_succeeds(tmp_path, monkeypatch):
+    path = _save(tmp_path / "ckpt", seed=6)
+    real_load = np.load
+    calls = {"n": 0}
+
+    def flaky(file, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError(5, "injected EIO", str(file))
+        return real_load(file, *args, **kwargs)
+
+    monkeypatch.setattr(np, "load", flaky)
+    _, exp = load_checkpoint(path, _tree(0), backoff_s=0.01)
+    assert exp["current_iter"] == 6
+    assert calls["n"] == 3
+
+
+def test_persistent_read_error_is_not_corrupt(tmp_path, monkeypatch):
+    """A persistent I/O failure must surface as plain CheckpointError, NOT
+    the corrupt subtype — the resume fallback would otherwise quarantine a
+    perfectly healthy checkpoint over an NFS blip."""
+    path = _save(tmp_path / "ckpt")
+
+    def always_eio(file, *args, **kwargs):
+        raise OSError(5, "injected EIO", str(file))
+
+    monkeypatch.setattr(np, "load", always_eio)
+    with pytest.raises(CheckpointError, match="transient") as err:
+        load_checkpoint(path, _tree(0), backoff_s=0.01)
+    assert not isinstance(err.value, CheckpointCorruptError)
+
+
+# ---------------------------------------------------------------------------
+# latest alias publisher (satellite: one serialization per epoch)
+# ---------------------------------------------------------------------------
+
+
+def test_publish_alias_retries_transient_failures(tmp_path):
+    """The write-retry contract covers BOTH halves of the epoch publish:
+    epoch file (save_checkpoint) AND latest alias (publish_alias)."""
+    epoch = _save(tmp_path / "train_model_3", seed=3)
+    latest = str(tmp_path / "train_model_latest")
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=2))
+    publish_alias(epoch, latest, backoff_s=0.01)
+    assert faultinject.events.count("write-fail:train_model_latest") == 2
+    _, exp = load_checkpoint(latest, _tree(0))
+    assert exp["current_iter"] == 3
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=99))
+    with pytest.raises(OSError, match="faultinject"):
+        publish_alias(epoch, latest, backoff_s=0.01)
+
+
+def test_publish_alias_is_loadable_and_hardlinked(tmp_path):
+    epoch_path = _save(tmp_path / "train_model_7", seed=7)
+    latest = str(tmp_path / "train_model_latest")
+    publish_alias(epoch_path, latest)
+    _, exp = load_checkpoint(latest, _tree(0))
+    assert exp["current_iter"] == 7
+    # Re-publishing over an existing alias replaces it atomically.
+    epoch8 = _save(tmp_path / "train_model_8", seed=8)
+    publish_alias(epoch8, latest)
+    _, exp = load_checkpoint(latest, _tree(0))
+    assert exp["current_iter"] == 8
+    # The epoch-7 file is untouched by the re-publish.
+    _, exp = load_checkpoint(epoch_path, _tree(0))
+    assert exp["current_iter"] == 7

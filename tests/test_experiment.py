@@ -219,6 +219,17 @@ def test_experiment_builder_end_to_end(tmp_path, monkeypatch):
     assert os.path.exists(os.path.join(logs, "test_summary.csv"))
     assert os.path.exists(os.path.join(logs, "summary_statistics.json"))
 
+    # Resume-stats regression pin (reference ordering bug, ISSUE 3
+    # satellite): the epoch-N checkpoint must contain epoch N's own stats
+    # row, otherwise a resume silently shifts the top-5 ensemble's
+    # val-stats-index -> checkpoint mapping.
+    for e in (1, 2, 3):
+        with np.load(os.path.join(saved, f"train_model_{e}")) as archive:
+            ckpt_state = json.loads(
+                bytes(archive["__experiment_state__"]).decode()
+            )
+        assert len(ckpt_state["per_epoch_statistics"]["val_accuracy_mean"]) == e
+
 
 def test_experiment_builder_resume(tmp_path, monkeypatch):
     make_dataset_dir(tmp_path / "omniglot_mini")

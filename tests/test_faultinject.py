@@ -1,0 +1,400 @@
+"""End-to-end fault-injection tests for the fault-tolerant runtime
+(ISSUE 3): every recovery pillar is proven against the REAL
+``ExperimentBuilder`` loop with deterministic injected failures —
+
+(a) resume with a truncated ``latest`` quarantines the corrupt files and
+    falls back to the newest valid epoch checkpoint;
+(b) SIGTERM mid-epoch writes an emergency checkpoint + requeue exit code,
+    and the resumed run matches the uninterrupted run bit-exactly in
+    params and task-seed sequence;
+(c) an injected NaN meta-loss halts with a typed error / is skipped
+    on-device / triggers checkpoint rollback, per ``--on_nonfinite``;
+(d) write-retry budget semantics live in ``test_checkpoint_integrity.py``.
+
+All tests are tiny CPU runs (2 epochs x 2 iters, 4-filter net); learners
+are cached per config so the XLA programs compile once for the module."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+    REQUEUE_EXIT_CODE,
+    ExperimentBuilder,
+    NonFiniteLossError,
+)
+from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+from howtotrainyourmamlpytorch_tpu.models.common import (
+    discard_nonfinite_update,
+    nonfinite_flag,
+)
+from howtotrainyourmamlpytorch_tpu.utils import faultinject, storage
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import args_to_maml_config
+
+from test_data import make_args, make_dataset_dir
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.deactivate()
+    yield
+    faultinject.reset()
+
+
+@pytest.fixture
+def dataset_env(tmp_path, monkeypatch):
+    make_dataset_dir(tmp_path / "omniglot_mini")
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _exp_args(tmp_path, name="exp", **overrides):
+    base = dict(
+        experiment_name=str(tmp_path / name),
+        seed=104, continue_from_epoch="latest", max_models_to_save=5,
+        total_epochs=2, total_iter_per_epoch=2, total_epochs_before_pause=100,
+        num_evaluation_tasks=4, evaluate_on_test_set_only=False, batch_size=2,
+        model="maml++",
+        num_stages=2, cnn_num_filters=4, conv_padding=True, max_pooling=True,
+        norm_layer="batch_norm", per_step_bn_statistics=True,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        num_classes_per_set=5, second_order=False,
+        first_order_to_second_order_epoch=-1,
+        use_multi_step_loss_optimization=True, multi_step_loss_num_epochs=2,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        enable_inner_loop_optimizable_bn_params=False,
+        learnable_bn_gamma=True, learnable_bn_beta=True,
+        meta_learning_rate=0.001, min_learning_rate=1e-5,
+        task_learning_rate=0.1, init_inner_loop_learning_rate=0.1,
+    )
+    base.update(overrides)
+    return make_args(tmp_path, **base)
+
+
+#: Config -> learner cache: the compiled XLA step programs are reused by
+#: every builder in this module (one compile per distinct MAMLConfig).
+_LEARNERS: dict = {}
+
+
+def _learner(args) -> MAMLFewShotLearner:
+    cfg = args_to_maml_config(args)
+    if cfg not in _LEARNERS:
+        _LEARNERS[cfg] = MAMLFewShotLearner(cfg)
+    return _LEARNERS[cfg]
+
+
+def _builder(args, data=MetaLearningSystemDataLoader) -> ExperimentBuilder:
+    return ExperimentBuilder(args=args, data=data, model=_learner(args),
+                             device=None)
+
+
+def _ckpt(path):
+    """Raw (leaf arrays, experiment state) straight from the npz."""
+    with np.load(path) as archive:
+        leaves = {k: archive[k] for k in archive.files if k.startswith("leaf_")}
+        state = json.loads(bytes(archive["__experiment_state__"]).decode())
+    return leaves, state
+
+
+class RecordingLoader(MetaLearningSystemDataLoader):
+    """Records the per-batch episode-seed arrays the train loop consumes —
+    the task-seed sequence of the run."""
+
+    records: list = []
+
+    def get_train_batches(self, **kwargs):
+        for batch in super().get_train_batches(**kwargs):
+            type(self).records.append(np.asarray(batch[4]).copy())
+            yield batch
+
+
+# ---------------------------------------------------------------------------
+# Harness unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_from_env(monkeypatch):
+    faultinject.reset()
+    monkeypatch.setenv(faultinject.ENV_VAR, "nan_at_iter=5; fail_next_writes=2")
+    plan = faultinject.current_plan()
+    assert plan.nan_at_iter == 5
+    assert plan.fail_next_writes == 2
+    faultinject.reset()
+    monkeypatch.setenv(faultinject.ENV_VAR, "explode_reactor=1")
+    with pytest.raises(ValueError, match="unknown fault"):
+        faultinject.current_plan()
+    faultinject.reset()
+    monkeypatch.delenv(faultinject.ENV_VAR)
+    assert faultinject.current_plan() is None
+
+
+def test_poison_batch_is_targeted_and_one_shot():
+    xs = np.zeros((2, 3), np.float32)
+    sample = (xs, xs.copy(), np.zeros(2, np.int32), np.zeros(2, np.int32), 7)
+    faultinject.activate(faultinject.FaultPlan(nan_at_iter=3))
+    same = faultinject.poison_batch(sample, 2)
+    assert same is sample  # wrong iteration: untouched
+    poisoned = faultinject.poison_batch(sample, 3)
+    assert np.isnan(poisoned[1]).all()
+    assert not np.isnan(poisoned[0]).any()  # support images untouched
+    assert faultinject.events == ["nan:3"]
+    assert faultinject.poison_batch(sample, 3) is sample  # consumed
+
+
+def test_sentinel_device_helpers():
+    assert float(nonfinite_flag(np.float32(1.0), np.ones(3))) == 0.0
+    assert float(nonfinite_flag(np.float32(np.nan))) == 1.0
+    assert float(nonfinite_flag(np.array([1.0, np.inf]))) == 1.0
+    new = {"w": np.ones(2, np.float32), "i": np.int32(5)}
+    old = {"w": np.zeros(2, np.float32), "i": np.int32(4)}
+    kept = discard_nonfinite_update(nonfinite_flag(np.float32(np.nan)), new, old)
+    np.testing.assert_array_equal(np.asarray(kept["w"]), old["w"])
+    taken = discard_nonfinite_update(nonfinite_flag(np.float32(2.0)), new, old)
+    np.testing.assert_array_equal(np.asarray(taken["w"]), new["w"])
+
+
+# ---------------------------------------------------------------------------
+# Pillar (b): preemption-safe shutdown + bit-exact resume
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_emergency_checkpoint_and_bitexact_resume(dataset_env):
+    tmp = dataset_env
+    latest_a = str(tmp / "exp_a" / "saved_models" / "train_model_latest")
+    latest_b = str(tmp / "exp_b" / "saved_models" / "train_model_latest")
+
+    # Run A: uninterrupted 2 epochs (pause exits cleanly at the end).
+    RecordingLoader.records = seeds_a = []
+    builder_a = _builder(
+        _exp_args(tmp, "exp_a", total_epochs_before_pause=2),
+        data=RecordingLoader,
+    )
+    with pytest.raises(SystemExit) as exit_a:
+        builder_a.run_experiment()
+    assert exit_a.value.code is None  # clean pause, not the requeue code
+    leaves_a, state_a = _ckpt(latest_a)
+    assert state_a["current_iter"] == 4
+
+    # Run B: SIGTERM delivered right after iteration 3 (mid-epoch 2).
+    RecordingLoader.records = seeds_b = []
+    faultinject.activate(faultinject.FaultPlan(sigterm_at_iter=3))
+    builder_b = _builder(_exp_args(tmp, "exp_b"), data=RecordingLoader)
+    with pytest.raises(SystemExit) as exit_b:
+        builder_b.run_experiment()
+    assert exit_b.value.code == REQUEUE_EXIT_CODE
+    assert faultinject.events == ["sigterm:3"]
+    _, state_mid = _ckpt(latest_b)
+    assert state_mid["current_iter"] == 3  # at most one dispatch "lost"
+    interruptions = storage.load_statistics(
+        str(tmp / "exp_b" / "logs"), filename="interruptions.csv"
+    )
+    assert interruptions["current_iter"] == ["3"]
+    faultinject.deactivate()
+
+    # Run B2: requeue (the resume command the exit code asks for).
+    builder_b2 = _builder(
+        _exp_args(tmp, "exp_b", total_epochs_before_pause=1),
+        data=RecordingLoader,
+    )
+    assert builder_b2.state["current_iter"] == 3
+    with pytest.raises(SystemExit):
+        builder_b2.run_experiment()
+
+    # Interrupted-then-resumed == uninterrupted: bit-exact params AND the
+    # identical task-seed sequence (B consumed windows 0-2, B2 window 3).
+    leaves_b, state_b = _ckpt(latest_b)
+    assert state_b["current_iter"] == 4
+    assert set(leaves_b) == set(leaves_a)
+    for key in leaves_a:
+        np.testing.assert_array_equal(leaves_a[key], leaves_b[key])
+    np.testing.assert_array_equal(
+        np.concatenate(seeds_a), np.concatenate(seeds_b)
+    )
+
+
+def test_shutdown_flag_honored_in_stateless_eval_phase(dataset_env):
+    """A SIGTERM during the test-ensemble phase (where state holds a
+    RELOADED old checkpoint) must exit promptly with the requeue code and
+    must NOT write an emergency checkpoint over ``latest``."""
+    import signal as _signal
+
+    tmp = dataset_env
+    builder = _builder(_exp_args(tmp))
+    builder._shutdown_signum = int(_signal.SIGTERM)
+    with pytest.raises(SystemExit) as exits:
+        builder._maybe_emergency_exit(write_checkpoint=False)
+    assert exits.value.code == REQUEUE_EXIT_CODE
+    assert os.listdir(str(tmp / "exp" / "saved_models")) == []
+    interruptions = storage.load_statistics(
+        str(tmp / "exp" / "logs"), filename="interruptions.csv"
+    )
+    assert interruptions["signal"] == [str(int(_signal.SIGTERM))]
+
+
+def test_legacy_csv_header_alignment_on_resume(dataset_env):
+    """Resuming an experiment whose summary CSV predates this build (no
+    trips/step-time columns) must append rows aligned to the FILE's header
+    instead of silently shifting every column after the mismatch."""
+    tmp = dataset_env
+    with pytest.raises(SystemExit):
+        _builder(_exp_args(tmp, total_epochs_before_pause=1)).run_experiment()
+    logs = str(tmp / "exp" / "logs")
+    csv_path = os.path.join(logs, "summary_statistics.csv")
+    with open(csv_path) as f:
+        rows = [line.rstrip("\n").split(",") for line in f]
+    dropped = ("train_nonfinite_trips", "train_step_time_p50",
+               "train_step_time_p95")
+    keep = [i for i, col in enumerate(rows[0]) if col not in dropped]
+    assert len(keep) < len(rows[0])  # the simulated legacy header is smaller
+    with open(csv_path, "w") as f:
+        for row in rows:
+            f.write(",".join(row[i] for i in keep) + "\n")
+    legacy_header = [rows[0][i] for i in keep]
+
+    with pytest.raises(SystemExit):
+        _builder(_exp_args(tmp, total_epochs_before_pause=1)).run_experiment()
+    stats = storage.load_statistics(logs)
+    assert list(stats.keys()) == legacy_header
+    assert stats["epoch"] == ["1", "2"]
+    assert [len(v) for v in stats.values()] == [2] * len(legacy_header)
+
+
+# ---------------------------------------------------------------------------
+# Pillar (a): corrupt-latest fallback on resume
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_latest_falls_back_to_newest_valid_epoch(dataset_env):
+    tmp = dataset_env
+    saved = str(tmp / "exp" / "saved_models")
+
+    # Phase 1: one clean epoch -> valid train_model_1 (+ latest alias).
+    with pytest.raises(SystemExit):
+        _builder(_exp_args(tmp, total_epochs_before_pause=1)).run_experiment()
+
+    # Phase 2: second epoch, but its checkpoint write is truncated at byte
+    # 100 (bit-rot / torn write that the atomic rename cannot guard).
+    faultinject.activate(faultinject.FaultPlan(truncate_checkpoint_at=100))
+    with pytest.raises(SystemExit):
+        _builder(_exp_args(tmp, total_epochs_before_pause=1)).run_experiment()
+    assert faultinject.events == ["truncate:train_model_2@100"]
+    faultinject.deactivate()
+
+    # Phase 3: resume degrades gracefully — latest (and its hardlinked
+    # epoch-2 file) are quarantined, epoch 1 loads, the run completes.
+    builder = _builder(_exp_args(tmp))
+    assert builder.state["current_iter"] == 2  # resumed from epoch 1
+    assert os.path.exists(os.path.join(saved, "train_model_latest.corrupt"))
+    assert os.path.exists(os.path.join(saved, "train_model_2.corrupt"))
+    assert not os.path.exists(os.path.join(saved, "train_model_latest"))
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+    # Epoch 2 was re-trained and re-checkpointed validly this time.
+    _, state = _ckpt(os.path.join(saved, "train_model_2"))
+    assert state["current_iter"] == 4
+
+
+def test_explicit_epoch_resume_propagates_typed_corruption(dataset_env):
+    """``--continue_from_epoch <int>`` on a corrupt file must raise the
+    typed error (the user named that exact checkpoint: no silent
+    fallback), not an opaque zipfile error."""
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+    )
+
+    tmp = dataset_env
+    with pytest.raises(SystemExit):
+        _builder(_exp_args(tmp, total_epochs_before_pause=1)).run_experiment()
+    path = str(tmp / "exp" / "saved_models" / "train_model_1")
+    with open(path, "r+b") as f:
+        f.truncate(64)
+    with pytest.raises(CheckpointCorruptError):
+        _builder(_exp_args(tmp, continue_from_epoch=1))
+
+
+# ---------------------------------------------------------------------------
+# Pillar (c): divergence sentinel policies
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_halt_raises_before_any_checkpoint(dataset_env):
+    tmp = dataset_env
+    faultinject.activate(faultinject.FaultPlan(nan_at_iter=1))
+    builder = _builder(_exp_args(tmp))  # --on_nonfinite defaults to halt
+    with pytest.raises(NonFiniteLossError, match="halt"):
+        builder.run_experiment()
+    assert faultinject.events == ["nan:1"]
+    # The poisoned epoch reached NO checkpoint and NO stats row.
+    assert os.listdir(str(tmp / "exp" / "saved_models")) == []
+    assert not os.path.exists(
+        str(tmp / "exp" / "logs" / "summary_statistics.csv")
+    )
+
+
+def test_sentinel_skip_discards_update_and_counts_trip(dataset_env):
+    tmp = dataset_env
+    faultinject.activate(faultinject.FaultPlan(nan_at_iter=1))
+    builder = _builder(_exp_args(tmp, on_nonfinite="skip"))
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+    leaves, state = _ckpt(
+        str(tmp / "exp" / "saved_models" / "train_model_latest")
+    )
+    for key, leaf in leaves.items():
+        assert np.isfinite(np.asarray(leaf, np.float64)).all(), key
+    assert state["nonfinite_trips_total"] == 1.0
+    # Trips are counted in the metrics dicts -> per-epoch stats + CSV.
+    assert state["per_epoch_statistics"]["train_nonfinite_trips"] == [1.0, 0.0]
+    stats = storage.load_statistics(str(tmp / "exp" / "logs"))
+    assert stats["train_nonfinite_trips"] == ["1.0", "0.0"]
+    # The masked epoch summary stays finite despite the NaN loss sample.
+    assert np.isfinite(float(stats["train_loss_mean"][0]))
+
+
+def test_sigterm_during_poisoned_epoch_never_checkpoints_nan(dataset_env):
+    """Sentinel x preemption interaction: a SIGTERM landing between a NaN
+    dispatch and its detection point must not persist the poisoned state
+    over the newest valid checkpoint. Under halt the shutdown path raises
+    the typed error instead of exiting with the requeue code."""
+    tmp = dataset_env
+    faultinject.activate(
+        faultinject.FaultPlan(nan_at_iter=2, sigterm_at_iter=3)
+    )
+    builder = _builder(_exp_args(tmp))  # halt policy (default)
+    with pytest.raises(NonFiniteLossError, match="poisoned"):
+        builder.run_experiment()
+    # latest is still epoch 1's valid checkpoint, not the NaN state.
+    leaves, state = _ckpt(
+        str(tmp / "exp" / "saved_models" / "train_model_latest")
+    )
+    assert state["current_iter"] == 2
+    for key, leaf in leaves.items():
+        assert np.isfinite(np.asarray(leaf, np.float64)).all(), key
+
+
+def test_sentinel_rollback_reloads_and_fastforwards_data(dataset_env):
+    tmp = dataset_env
+    # Poison the first iteration of epoch 2: epoch 1's checkpoint exists,
+    # the poisoned update then propagates NaN through iteration 3, and the
+    # boundary sentinel rolls back to epoch 1 with a shifted seed window.
+    faultinject.activate(faultinject.FaultPlan(nan_at_iter=2))
+    builder = _builder(_exp_args(tmp, on_nonfinite="rollback"))
+    test_losses = builder.run_experiment()
+    assert 0.0 <= test_losses["test_accuracy_mean"] <= 1.0
+    assert faultinject.events == ["nan:2"]
+    leaves, state = _ckpt(
+        str(tmp / "exp" / "saved_models" / "train_model_2")
+    )
+    for key, leaf in leaves.items():
+        assert np.isfinite(np.asarray(leaf, np.float64)).all(), key
+    assert state["current_iter"] == 4
+    assert state["nonfinite_rollbacks"] == 1
+    assert state["nonfinite_trips_total"] == 2.0  # iters 2 and 3 tripped
+    # Exactly one stats row per epoch: the poisoned epoch never reached the
+    # CSV, only its clean replay did.
+    stats = storage.load_statistics(str(tmp / "exp" / "logs"))
+    assert len(stats["epoch"]) == 2

@@ -1,0 +1,117 @@
+"""Direct unit tests for ``utils/storage.py`` (previously covered only
+incidentally through ``test_experiment.py``): CSV create/append/load
+round-trips, ragged-row behavior, and the atomic-JSON crash contract."""
+
+import json
+import os
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.utils import storage
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import Bunch
+
+
+# ---------------------------------------------------------------------------
+# CSV statistics
+# ---------------------------------------------------------------------------
+
+
+def test_csv_create_overwrites_and_append_extends(tmp_path):
+    exp = str(tmp_path)
+    storage.save_statistics(exp, ["a", "b", "c"], create=True)
+    storage.save_statistics(exp, [1, 2, 3])
+    # create=True truncates: a restart that re-creates starts a fresh file.
+    storage.save_statistics(exp, ["a", "b", "c"], create=True)
+    storage.save_statistics(exp, [4.5, "x", -1])
+    loaded = storage.load_statistics(exp)
+    assert loaded == {"a": ["4.5"], "b": ["x"], "c": ["-1"]}
+
+
+def test_csv_roundtrip_multiple_rows_preserves_order(tmp_path):
+    exp = str(tmp_path)
+    storage.save_statistics(exp, ["epoch", "loss"], create=True)
+    for e in range(5):
+        storage.save_statistics(exp, [e, e * 0.5])
+    loaded = storage.load_statistics(exp)
+    assert loaded["epoch"] == [str(e) for e in range(5)]
+    assert loaded["loss"] == [str(e * 0.5) for e in range(5)]
+
+
+def test_csv_custom_filename_isolated(tmp_path):
+    exp = str(tmp_path)
+    storage.save_statistics(exp, ["x"], create=True)
+    storage.save_statistics(exp, ["y"], create=True, filename="other.csv")
+    storage.save_statistics(exp, [1])
+    storage.save_statistics(exp, [2], filename="other.csv")
+    assert storage.load_statistics(exp) == {"x": ["1"]}
+    assert storage.load_statistics(exp, filename="other.csv") == {"y": ["2"]}
+
+
+def test_csv_ragged_rows_load_without_crashing(tmp_path):
+    """Contract pin: a short row contributes only the columns it has, and
+    surplus values in a long row are dropped (zip semantics) — loading must
+    never raise on a file a crashed run left ragged."""
+    exp = str(tmp_path)
+    storage.save_statistics(exp, ["a", "b", "c"], create=True)
+    storage.save_statistics(exp, [1, 2])         # short row
+    storage.save_statistics(exp, [3, 4, 5, 6])   # long row
+    loaded = storage.load_statistics(exp)
+    assert loaded["a"] == ["1", "3"]
+    assert loaded["b"] == ["2", "4"]
+    assert loaded["c"] == ["5"]  # short row contributed nothing to c
+
+
+# ---------------------------------------------------------------------------
+# Atomic JSON
+# ---------------------------------------------------------------------------
+
+
+def test_save_to_json_roundtrip_and_no_tmp_left(tmp_path):
+    path = str(tmp_path / "log.json")
+    storage.save_to_json(path, {"k": [1, 2], "s": "v"})
+    assert storage.load_from_json(path) == {"k": [1, 2], "s": "v"}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_save_to_json_crash_mid_dump_keeps_old_file(tmp_path, monkeypatch):
+    """The satellite fix: a crash mid-dump must not destroy the existing
+    file (the old truncate-then-write lost ``summary_statistics.json`` /
+    ``experiment_log.json`` permanently)."""
+    path = str(tmp_path / "log.json")
+    storage.save_to_json(path, {"epoch": 1})
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("simulated crash mid-dump")
+
+    monkeypatch.setattr(storage.json, "dump", boom)
+    with pytest.raises(RuntimeError, match="mid-dump"):
+        storage.save_to_json(path, {"epoch": 2})
+    monkeypatch.undo()
+    assert storage.load_from_json(path) == {"epoch": 1}
+
+
+def test_experiment_log_create_and_update(tmp_path):
+    logs = str(tmp_path)
+    args = Bunch({"seed": 1, "dataset_name": "omniglot"})
+    storage.create_json_experiment_log(logs, args)
+    storage.update_json_experiment_log_epoch_stats(
+        {"train_loss_mean": 0.5}, logs
+    )
+    storage.update_json_experiment_log_epoch_stats(
+        {"train_loss_mean": 0.25}, logs
+    )
+    summary = storage.load_from_json(os.path.join(logs, "experiment_log.json"))
+    assert summary["seed"] == 1
+    assert summary["epoch_stats"]["train_loss_mean"] == [0.5, 0.25]
+    assert summary["experiment_status"][0][1] == "initialization"
+    # Raw JSON on disk is valid (atomic write published a complete file).
+    with open(os.path.join(logs, "experiment_log.json")) as f:
+        json.load(f)
+
+
+def test_build_experiment_folder_idempotent(tmp_path):
+    first = storage.build_experiment_folder(str(tmp_path / "exp"))
+    second = storage.build_experiment_folder(str(tmp_path / "exp"))
+    assert first == second
+    for p in first:
+        assert os.path.isdir(p)

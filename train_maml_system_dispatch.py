@@ -91,9 +91,16 @@ def main() -> int:
 
     try:
         max_phases = 2 * (total_epochs // (pause_every or total_epochs) + 2)
-        stalled = 0
+        # Requeue exits (rc 75, experiment_builder.REQUEUE_EXIT_CODE) are
+        # preemption-safe: an emergency checkpoint was written mid-epoch, so
+        # re-entering is always progress even though no epoch row landed.
+        # They get their own (generous) budget instead of consuming the
+        # phase budget — a heavily-preempted long run must not abort as
+        # "budget exhausted" while advancing monotonically.
+        max_requeues = 100
+        stalled = phase = requeues = 0
         rc = 0
-        for phase in range(max_phases):
+        while phase < max_phases and requeues < max_requeues:
             before = epochs_logged()
             print(f"--- {cfg}: phase {phase} via {entry} "
                   f"(epochs logged: {before}/{total_epochs})", flush=True)
@@ -104,6 +111,11 @@ def main() -> int:
             rc = proc.returncode
             if os.path.exists(test_csv):
                 break
+            if rc == 75:
+                stalled = 0
+                requeues += 1
+                continue
+            phase += 1
             if epochs_logged() <= before:
                 stalled += 1
                 if stalled >= 2:
