@@ -2,8 +2,9 @@
 
 from .backbone import BackboneConfig, VGGBackbone, build_backbone
 from .resnet import ResNet12Backbone
-from .maml import MAMLConfig, MAMLFewShotLearner
-from .gradient_descent import GradientDescentLearner
+from .common import InferenceState
+from .maml import MAMLConfig, MAMLFewShotLearner, MAMLInferenceState
+from .gradient_descent import GDInferenceState, GradientDescentLearner
 from .matching_nets import MatchingNetsLearner
 
 __all__ = [
@@ -11,8 +12,11 @@ __all__ = [
     "VGGBackbone",
     "ResNet12Backbone",
     "build_backbone",
+    "GDInferenceState",
+    "InferenceState",
     "MAMLConfig",
     "MAMLFewShotLearner",
+    "MAMLInferenceState",
     "GradientDescentLearner",
     "MatchingNetsLearner",
 ]

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -218,6 +218,21 @@ def prepare_batch(data_batch, codec: WireCodec | None = None):
     return xs, xt, ys.reshape(b, -1), yt.reshape(b, -1)
 
 
+class InferenceState(NamedTuple):
+    """Params + BN-stats slice of a baseline train state — everything the
+    serving path (``serve/``) needs, and nothing it doesn't.
+
+    Field order is the PREFIX of ``GDState``/``MatchingNetsState`` in
+    flatten order, which is what lets ``utils/checkpoint.load_for_inference``
+    restore it from a full training checkpoint without ever constructing
+    (or paying RAM for) the optimizer moments. The MAML learner has its own
+    ``MAMLInferenceState`` (extra ``lslr`` field), same prefix property.
+    """
+
+    theta: Any
+    bn_state: Any
+
+
 class CheckpointableLearner:
     """Reference trainer-contract checkpoint methods
     (``few_shot_learning_system.py:399-424``): ``save_model`` writes the full
@@ -239,3 +254,17 @@ class CheckpointableLearner:
         filepath = os.path.join(model_save_dir, f"{model_name}_{model_idx}")
         template = self.init_state(jax.random.PRNGKey(0))
         return load_checkpoint(filepath, template)
+
+    def load_inference_state(self, filepath: str):
+        """Serving cold-start load: restores the learner's params+BN
+        inference slice (``init_inference_state`` template) from a full
+        training checkpoint — no optimizer state constructed or loaded.
+        Returns ``(inference_state, experiment_state)``. Learners with
+        serve-time state beyond the checkpoint prefix override this (GD
+        attaches the epoch-schedule fine-tune lr)."""
+        import jax
+
+        from ..utils.checkpoint import load_for_inference
+
+        template = self.init_inference_state(jax.random.PRNGKey(0))
+        return load_for_inference(filepath, template)

@@ -37,6 +37,7 @@ from ..ops import accuracy, cross_entropy
 from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
+    InferenceState,
     cosine_epoch_lr,
     decode_images,
     guard_nonfinite_update,
@@ -56,6 +57,19 @@ class GDState(NamedTuple):
     bn_state: Tree
     opt_state: Tree
     iteration: jax.Array
+
+
+class GDInferenceState(NamedTuple):
+    """GD's SERVE-side state: the ``InferenceState`` prefix plus the
+    fine-tune learning rate as DATA (a traced scalar, so a hot checkpoint
+    swap to a different training epoch can never serve a stale baked-in
+    rate). Never a checkpoint-load template — ``init_inference_state``
+    stays the plain prefix; the lr is attached by ``inference_state`` /
+    ``load_inference_state``."""
+
+    theta: Tree
+    bn_state: Tree
+    fine_tune_lr: jax.Array
 
 
 class GradientDescentLearner(CheckpointableLearner):
@@ -206,3 +220,111 @@ class GradientDescentLearner(CheckpointableLearner):
             "nonfinite": metrics["nonfinite"],
         }
         return new_state, losses, logits
+
+    # ------------------------------------------------------------------
+    # Serving contract (serve/engine.py)
+    # ------------------------------------------------------------------
+    #
+    # Serving adaptation = the eval fine-tune on the support set, per task,
+    # from the served checkpoint; classify = the target forward the eval
+    # path scores BEFORE its post-hoc target update (gradient_descent.py's
+    # ``t_logits``). Two DOCUMENTED divergences from run_validation_iter,
+    # both inherent to serving:
+    #
+    # * each request fine-tunes independently from the served state — the
+    #   eval harness threads the mutated weights sequentially across the
+    #   batch, which would make one user's request perturb another's answer
+    #   (parity is therefore bit-exact for a single-episode batch, the only
+    #   case where "sequential" and "independent" coincide — pinned by
+    #   tests/test_serve_parity.py);
+    # * the per-request Adam moments start fresh (zeros) rather than from
+    #   the training run's moment tree — ``load_for_inference`` never loads
+    #   optimizer state. Fresh moments inside the jitted adapt program cost
+    #   nothing on host; bit-exact vs a freshly initialized ``GDState``.
+    #
+    # The fine-tune LEARNING RATE is not a divergence: it rides the serve
+    # state as data (``GDInferenceState.fine_tune_lr``) — taken from the
+    # live injected lr when serving a ``GDState``, recomputed from the
+    # checkpoint's recorded training progress (epoch cosine schedule, the
+    # same value ``run_train_iter`` injected that epoch) when cold-starting
+    # via ``load_inference_state``. Without this a checkpoint trained to a
+    # decayed lr would silently fine-tune requests ~100x hotter than the
+    # validation that qualified it.
+
+    def init_inference_state(self, key: jax.Array) -> InferenceState:
+        """Params + BN template for ``load_for_inference`` — no optimizer."""
+        theta, bn_state = self.backbone.init(key)
+        return InferenceState(theta=theta, bn_state=bn_state)
+
+    def inference_state(self, state) -> GDInferenceState:
+        if isinstance(state, GDInferenceState):
+            return state
+        if isinstance(state, GDState):
+            lr = state.opt_state.hyperparams["learning_rate"]
+        else:  # bare InferenceState (e.g. a fresh init): schedule start
+            lr = jnp.asarray(self.cfg.meta_learning_rate, jnp.float32)
+        return GDInferenceState(
+            theta=state.theta, bn_state=state.bn_state, fine_tune_lr=lr
+        )
+
+    def load_inference_state(self, filepath: str):
+        """Serving cold-start load: the params+BN prefix plus the epoch-
+        schedule fine-tune lr recomputed from the checkpoint's recorded
+        ``current_iter`` — the value training injected that epoch."""
+        from ..utils.checkpoint import load_for_inference
+
+        template = self.init_inference_state(jax.random.PRNGKey(0))
+        loaded, experiment_state = load_for_inference(filepath, template)
+        epoch = int(
+            int(experiment_state.get("current_iter", 0))
+            / max(int(self.cfg.total_iter_per_epoch), 1)
+        )
+        lr = jnp.asarray(self._epoch_lr(epoch), jnp.float32)
+        return (
+            GDInferenceState(
+                theta=loaded.theta,
+                bn_state=loaded.bn_state,
+                fine_tune_lr=lr,
+            ),
+            experiment_state,
+        )
+
+    def serve_adapt(self, istate: GDInferenceState, x_support, y_support):
+        """ONE task's support fine-tune (the eval step count), returning the
+        adapted full parameter tree — this baseline's cacheable artifact."""
+        backbone = self.backbone
+        x_support = decode_images(x_support, self.cfg.wire_codec, jnp.float32)
+        opt_state = self.tx.init(istate.theta)
+        # The injected-Adam lr is state, not config: overwrite the freshly
+        # initialized hyperparam with the served rate (same mechanism as
+        # ``set_injected_lr``, but inside the traced program).
+        opt_state.hyperparams["learning_rate"] = jnp.asarray(
+            istate.fine_tune_lr, jnp.float32
+        )
+
+        def step_fn(carry, _):
+            theta, bn, opt_state = carry
+
+            def support_loss_fn(theta_):
+                logits, bn1 = backbone.apply(theta_, bn, x_support, 0)
+                return cross_entropy(logits, y_support), bn1
+
+            (_, bn), grads = jax.value_and_grad(
+                support_loss_fn, has_aux=True
+            )(theta)
+            theta, opt_state = self._update(grads, opt_state, theta)
+            return (theta, bn, opt_state), None
+
+        (theta, _, _), _ = lax.scan(
+            step_fn,
+            (istate.theta, istate.bn_state, opt_state),
+            None,
+            length=self.cfg.number_of_evaluation_steps_per_iter,
+        )
+        return theta
+
+    def serve_classify(self, istate: GDInferenceState, adapted, x_query):
+        """ONE task's query forward with the fine-tuned weights."""
+        x_query = decode_images(x_query, self.cfg.wire_codec, jnp.float32)
+        logits, _ = self.backbone.apply(adapted, istate.bn_state, x_query, 0)
+        return logits.astype(jnp.float32)

@@ -188,6 +188,18 @@ class TrainState(NamedTuple):
     iteration: jax.Array  # outer iterations taken (drives the LR schedule)
 
 
+class MAMLInferenceState(NamedTuple):
+    """The serving-path slice of ``TrainState``: everything adapt+classify
+    reads, nothing the outer optimizer owns. Field order is the PREFIX of
+    ``TrainState`` in flatten order — the contract
+    ``utils/checkpoint.load_for_inference`` relies on to restore it from a
+    full training checkpoint without constructing Adam moments."""
+
+    theta: Tree
+    lslr: Tree
+    bn_state: Tree
+
+
 class MAMLFewShotLearner(CheckpointableLearner):
     """The MAML/MAML++ trainer: owns config, backbone, optimizer, and the
     compiled train/eval step functions.
@@ -752,3 +764,101 @@ class MAMLFewShotLearner(CheckpointableLearner):
             "accuracy": metrics["accuracy"],
         }
         return state, losses, logits
+
+    # ------------------------------------------------------------------
+    # Serving contract (serve/engine.py)
+    # ------------------------------------------------------------------
+    #
+    # The serving runtime splits run_validation_iter's fused episode program
+    # into two per-task pure functions so the adapted params become a
+    # cacheable artifact: serve_adapt (support set -> fast weights, the
+    # inner loop) and serve_classify (fast weights + queries -> logits).
+    # Both are the exact sub-graphs of _task_adapt_and_losses that determine
+    # the eval logits, so a served episode's predictions are BIT-EXACT with
+    # run_validation_iter (pinned by tests/test_serve_parity.py). Eval
+    # predictions come from the target forward after min(train, eval) inner
+    # updates at that step index (the reference's pred_step condition,
+    # few_shot_learning_system.py:239) — later eval steps never influence
+    # the returned logits, so serving stops adapting there.
+
+    @property
+    def serve_adapt_steps(self) -> int:
+        """Inner updates that determine the eval prediction (see above)."""
+        return min(
+            self.cfg.number_of_training_steps_per_iter,
+            self.cfg.number_of_evaluation_steps_per_iter,
+        )
+
+    def init_inference_state(self, key: jax.Array) -> MAMLInferenceState:
+        """Template for ``load_for_inference``: params + LSLR + BN stats,
+        WITHOUT touching the optimizer — serving cold-start never builds
+        (or pays host RAM for) the Adam moment trees."""
+        theta, bn_state = self.backbone.init(key, dtype=jnp.float32)
+        mask = self.backbone.inner_loop_mask(theta)
+        adapt, _ = partition(theta, mask)
+        lslr = init_lslr(
+            adapt,
+            self.cfg.number_of_training_steps_per_iter,
+            self.cfg.task_learning_rate,
+        )
+        return MAMLInferenceState(theta=theta, lslr=lslr, bn_state=bn_state)
+
+    def inference_state(self, state) -> MAMLInferenceState:
+        """Slims a full ``TrainState`` to the serving slice (passthrough for
+        an already-slim state)."""
+        if isinstance(state, MAMLInferenceState):
+            return state
+        return MAMLInferenceState(
+            theta=state.theta, lslr=state.lslr, bn_state=state.bn_state
+        )
+
+    def serve_adapt(self, istate: MAMLInferenceState, x_support, y_support):
+        """ONE task's inner-loop adaptation — the support-side projection of
+        ``_task_adapt_and_losses`` under eval semantics (first order, eval's
+        fused-norm gating). Returns the adapted fast-weight pytree, the
+        cacheable artifact keyed by the support-set digest."""
+        backbone = self.backbone
+        mask = backbone.inner_loop_mask(istate.theta)
+        adapt0, frozen = partition(istate.theta, mask)
+        x_support = decode_images(x_support, self.cfg.wire_codec, self.cfg.dtype)
+        fused = "vjp" if backbone.cfg.use_pallas_fused_norm else "off"
+
+        def step_fn(carry, step):
+            fast, bn = carry
+
+            def support_loss_fn(fast_):
+                logits, bn1 = backbone.apply(
+                    merge(fast_, frozen), bn, x_support, step, fused=fused
+                )
+                return cross_entropy(logits, y_support), bn1
+
+            (_, bn1), grads = jax.value_and_grad(support_loss_fn, has_aux=True)(
+                fast
+            )
+            grads = lax.stop_gradient(grads)
+            fast = lslr_update(fast, grads, istate.lslr, step)
+            return (fast, bn1), None
+
+        (fast_final, _), _ = lax.scan(
+            step_fn, (adapt0, istate.bn_state), jnp.arange(self.serve_adapt_steps)
+        )
+        return fast_final
+
+    def serve_classify(self, istate: MAMLInferenceState, adapted, x_query):
+        """ONE task's query forward with adapted fast weights — the target
+        pass of ``_task_adapt_and_losses`` at the eval prediction step.
+        BN running stats never influence outputs (``ops/norm.py``), so the
+        template ``bn_state`` stands in for the adapt-evolved one."""
+        backbone = self.backbone
+        mask = backbone.inner_loop_mask(istate.theta)
+        _, frozen = partition(istate.theta, mask)
+        x_query = decode_images(x_query, self.cfg.wire_codec, self.cfg.dtype)
+        fused = "vjp" if backbone.cfg.use_pallas_fused_norm else "off"
+        logits, _ = backbone.apply(
+            merge(adapted, frozen),
+            istate.bn_state,
+            x_query,
+            self.serve_adapt_steps - 1,
+            fused=fused,
+        )
+        return logits.astype(jnp.float32)

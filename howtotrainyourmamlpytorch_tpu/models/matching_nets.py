@@ -46,6 +46,7 @@ from ..ops import accuracy
 from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
+    InferenceState,
     cosine_epoch_lr,
     decode_images,
     guard_nonfinite_update,
@@ -123,10 +124,11 @@ class MatchingNetsLearner(CheckpointableLearner):
             epoch, cfg.meta_learning_rate, cfg.min_learning_rate, cfg.total_epochs
         )
 
-    def _task_loss(self, theta, bn, xs, ys, xt, yt):
+    def _predictions(self, support_emb, target_emb, ys):
+        """Attention-mixed class probabilities from the two embedding sets —
+        shared by the train/eval episode program and the serving classify
+        path (``serve_classify``), so both branches stay one graph."""
         num_classes = self.cfg.backbone.num_classes
-        support_emb, bn1 = self.backbone.apply(theta, bn, xs, 0)
-        target_emb, bn2 = self.backbone.apply(theta, bn1, xt, 0)
         if self.parity_bug:
             # Bug-for-bug reference reproduction (matching_nets.py:338-352,
             # 98-145), verified numerically exact by
@@ -147,7 +149,16 @@ class MatchingNetsLearner(CheckpointableLearner):
             )
             sm = jax.nn.softmax(sims_st, axis=1)
             onehot = jax.nn.one_hot(ys, num_classes, dtype=sm.dtype)
-            preds = sm @ onehot
+            return sm @ onehot
+        return cosine_attention_predictions(
+            support_emb, target_emb, ys, num_classes
+        )
+
+    def _task_loss(self, theta, bn, xs, ys, xt, yt):
+        support_emb, bn1 = self.backbone.apply(theta, bn, xs, 0)
+        target_emb, bn2 = self.backbone.apply(theta, bn1, xt, 0)
+        preds = self._predictions(support_emb, target_emb, ys)
+        if self.parity_bug:
             log_probs = jax.nn.log_softmax(preds, axis=-1)
             loss = -jnp.mean(
                 jnp.take_along_axis(
@@ -155,9 +166,6 @@ class MatchingNetsLearner(CheckpointableLearner):
                 )
             )
         else:
-            preds = cosine_attention_predictions(
-                support_emb, target_emb, ys, num_classes
-            )
             loss = -jnp.mean(
                 jnp.log(
                     jnp.take_along_axis(
@@ -243,3 +251,43 @@ class MatchingNetsLearner(CheckpointableLearner):
             "accuracy": metrics["accuracy"],
         }
         return state, losses, preds
+
+    # ------------------------------------------------------------------
+    # Serving contract (serve/engine.py)
+    # ------------------------------------------------------------------
+    #
+    # Matching nets classify without gradient adaptation — "adapt" is just
+    # embedding the support set once. The cacheable artifact is therefore
+    # the support embeddings + labels: a few KB per episode (vs a full
+    # parameter tree for MAML/GD), which is what makes the adapted-params
+    # cache disproportionately effective for this learner.
+
+    def init_inference_state(self, key: jax.Array) -> InferenceState:
+        """Params + BN template for ``load_for_inference`` — no optimizer."""
+        theta, bn_state = self.backbone.init(key)
+        return InferenceState(theta=theta, bn_state=bn_state)
+
+    def inference_state(self, state) -> InferenceState:
+        if isinstance(state, InferenceState):
+            return state
+        return InferenceState(theta=state.theta, bn_state=state.bn_state)
+
+    def serve_adapt(self, istate: InferenceState, x_support, y_support):
+        """ONE task's support embedding — adaptation-free 'adapt'."""
+        x_support = decode_images(x_support, self.cfg.wire_codec, jnp.float32)
+        emb, _ = self.backbone.apply(istate.theta, istate.bn_state, x_support, 0)
+        return {"support_emb": emb, "support_labels": y_support}
+
+    def serve_classify(self, istate: InferenceState, adapted, x_query):
+        """ONE task's attention classify against the cached support
+        embeddings. Returns class probabilities — the same per-task ``preds``
+        ``run_validation_iter`` reports (BN stats never affect outputs, so
+        embedding queries with the template state matches the eval graph's
+        support-evolved state bit-for-bit)."""
+        x_query = decode_images(x_query, self.cfg.wire_codec, jnp.float32)
+        target_emb, _ = self.backbone.apply(
+            istate.theta, istate.bn_state, x_query, 0
+        )
+        return self._predictions(
+            adapted["support_emb"], target_emb, adapted["support_labels"]
+        ).astype(jnp.float32)

@@ -1,0 +1,39 @@
+"""TPU-native few-shot adaptation serving runtime.
+
+The production inference workload MAML exists for (PAPER.md): load a
+trained initialization, adapt to a request's support set in a few gradient
+steps, answer its queries — at traffic, without per-request XLA compiles.
+
+Layers (each its own module, composable without the HTTP frontend):
+
+* ``engine``  — shape-bucketed compiled adapt/classify program pairs with
+  task-axis padding; the zero-recompile contract.
+* ``batcher`` — deadline micro-batching: concurrent episodes share one
+  meta-batch dispatch.
+* ``cache``   — LRU adapted-params cache keyed by support-set digest;
+  repeat support sets skip the inner loop.
+* ``metrics`` — latency quantiles / counters / Prometheus text.
+* ``api``     — ``ServingAPI`` (in-process) + the stdlib HTTP frontend
+  (``/v1/episode``, ``/healthz``, ``/metrics``).
+
+Entry points: ``tools/serve_maml.py`` (server CLI), ``tools/serve_bench.py``
+(bench keys: ``serve_qps`` / ``serve_adapt_p50_ms`` / ``serve_cache_hit_qps``).
+"""
+
+from .api import ServingAPI, make_http_server
+from .batcher import MicroBatcher
+from .cache import AdaptedParamsCache, support_digest
+from .engine import EpisodeRequest, ServeConfig, ServingEngine
+from .metrics import ServeMetrics
+
+__all__ = [
+    "ServingAPI",
+    "make_http_server",
+    "MicroBatcher",
+    "AdaptedParamsCache",
+    "support_digest",
+    "EpisodeRequest",
+    "ServeConfig",
+    "ServingEngine",
+    "ServeMetrics",
+]

@@ -1,0 +1,213 @@
+"""Serving frontends: the in-process Python API and the stdlib HTTP server.
+
+``ServingAPI`` is the composition root — engine + batcher + cache + metrics
+behind one synchronous ``classify`` call — and is what embedders (and the
+bench harness, ``tools/serve_bench.py``) use directly. The HTTP frontend is
+a deliberately minimal ``http.server`` wrapper over the same object: one
+POST route for episodes plus the two operational endpoints every fleet
+scraper assumes (``/healthz``, ``/metrics``). No framework — the container
+bakes no web dependencies, and the device pipeline (one batcher worker) is
+the throughput ceiling anyway, not HTTP parsing.
+
+Endpoints::
+
+    POST /v1/episode   {"support": [...], "support_labels": [...],
+                        "query": [...]}
+                       -> {"logits": [[...]], "predictions": [...],
+                           "cache_hit": bool, "bucket": "5x1x15", ...}
+    GET  /healthz      -> {"status": "ok", ...}
+    GET  /metrics      -> Prometheus text (latency p50/p99 for adapt and
+                          classify, queue depth, cache hit rate, per-bucket
+                          episode + compile tables)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .batcher import MicroBatcher
+from .engine import ServeConfig, ServingEngine
+from .metrics import ServeMetrics
+
+#: Hard cap on request body bytes (a 64 MB episode is ~200 84x84x3 images
+#: as JSON — anything bigger is a malformed or hostile request).
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class ServingAPI:
+    """In-process few-shot serving: adapt+classify episodes against one
+    loaded checkpoint."""
+
+    def __init__(self, learner, state, config: ServeConfig | None = None):
+        self.metrics = ServeMetrics()
+        self.engine = ServingEngine(
+            learner, state, config=config, metrics=self.metrics
+        )
+        self.batcher = MicroBatcher(self.engine)
+        self.started_at = time.time()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+
+    def classify(
+        self, x_support, y_support, x_query, *, timeout: float | None = 30.0
+    ) -> dict:
+        """Adapts to the support set and classifies the queries.
+
+        Returns ``logits`` (``(T, num_classes)`` float32), per-query
+        ``predictions``, whether the adapted params came from cache, and
+        the shape bucket the episode rode. Raises ``ValueError`` for
+        malformed episodes and builtin ``TimeoutError`` if the deadline
+        passes (``concurrent.futures.TimeoutError`` is translated — on
+        Python < 3.11 they are distinct classes)."""
+        t0 = time.perf_counter()
+        # Counted on OFFER, not success: a server failing every request
+        # must not look idle on a dashboard.
+        self.metrics.requests_total.inc()
+        try:
+            episode = self.engine.prepare_episode(
+                x_support, y_support, x_query
+            )
+            cache_hit = episode.digest in self.engine.cache
+            future = self.batcher.submit(episode)
+            try:
+                logits = future.result(timeout=timeout)
+            except futures.TimeoutError:
+                future.cancel()
+                raise TimeoutError(
+                    f"dispatch exceeded the {timeout} s deadline"
+                ) from None
+        except Exception:
+            self.metrics.request_errors.inc()
+            raise
+        self.metrics.request_latency.observe((time.perf_counter() - t0) * 1e3)
+        return {
+            "logits": logits,
+            "predictions": np.argmax(logits, axis=-1),
+            "cache_hit": cache_hit,
+            "bucket": "x".join(str(d) for d in episode.bucket),
+            "state_version": self.engine.state_version,
+        }
+
+    def update_state(self, state) -> int:
+        """Hot-swaps the served checkpoint (see ``ServingEngine``)."""
+        return self.engine.update_state(state)
+
+    def healthz(self) -> dict:
+        return {
+            "status": "ok",
+            "family": self.engine.family,
+            "state_version": self.engine.state_version,
+            "uptime_s": time.time() - self.started_at,
+            "episodes_served": self.metrics.episodes_served.value,
+        }
+
+    def stats(self) -> dict:
+        return self.metrics.snapshot(
+            queue_depth=self.batcher.queue_depth(),
+            compile_table=self.engine.compile_table(),
+        )
+
+    def metrics_text(self) -> str:
+        return self.metrics.render_prometheus(
+            queue_depth=self.batcher.queue_depth(),
+            compile_table=self.engine.compile_table(),
+        )
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes requests onto the bound ``ServingAPI`` (set by
+    ``make_http_server``)."""
+
+    api: ServingAPI  # bound per-server subclass
+    protocol_version = "HTTP/1.1"
+
+    # Quiet by default: serving logs belong to metrics, not stderr spam.
+    def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(
+            code, json.dumps(payload).encode(), "application/json"
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        if self.path == "/healthz":
+            self._send_json(200, self.api.healthz())
+        elif self.path == "/metrics":
+            self._send(
+                200, self.api.metrics_text().encode(), "text/plain; version=0.0.4"
+            )
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
+        if self.path != "/v1/episode":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0 or length > MAX_BODY_BYTES:
+                self._send_json(
+                    413 if length > MAX_BODY_BYTES else 400,
+                    {"error": f"bad Content-Length {length}"},
+                )
+                return
+            payload = json.loads(self.rfile.read(length))
+            result = self.api.classify(
+                payload["support"],
+                payload["support_labels"],
+                payload["query"],
+            )
+        except (KeyError, ValueError, TypeError) as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        except TimeoutError:
+            self._send_json(503, {"error": "dispatch timed out"})
+            return
+        except Exception as exc:  # dispatch failure: visible, not a hang
+            self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._send_json(
+            200,
+            {
+                "logits": np.asarray(result["logits"]).tolist(),
+                "predictions": np.asarray(result["predictions"]).tolist(),
+                "cache_hit": bool(result["cache_hit"]),
+                "bucket": result["bucket"],
+                "state_version": result["state_version"],
+            },
+        )
+
+
+def make_http_server(
+    api: ServingAPI, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Builds (does not start) the HTTP server; ``port=0`` binds an
+    ephemeral port — read it back from ``server.server_address``. Run with
+    ``serve_forever()`` (blocking) or a daemon thread (tests, embedders)."""
+
+    handler = type("BoundServeHandler", (_Handler,), {"api": api})
+    return ThreadingHTTPServer((host, port), handler)

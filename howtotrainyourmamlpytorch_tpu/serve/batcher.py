@@ -1,0 +1,143 @@
+"""Deadline-based request micro-batching.
+
+The throughput lever of the serving runtime: the learners were TRAINED
+with a vmapped meta-batch axis, so the device program is already shaped to
+answer B episodes for barely more than the cost of one — the batcher's job
+is to refill that axis from CONCURRENT traffic. Each incoming episode
+joins the pending group for its shape bucket; a group flushes when it
+reaches ``max_batch`` episodes (the engine's fixed meta-batch) or when its
+oldest request has waited ``max_wait_ms`` — the classic
+latency-vs-throughput dial (0 ms degenerates to per-request dispatch,
+large values trade tail latency for device efficiency).
+
+One worker thread owns all dispatching; callers block on a
+``concurrent.futures.Future`` so the public API stays synchronous while
+arbitrarily many frontend threads (the HTTP handler pool) share one device
+pipeline. Dispatch runs OUTSIDE the queue lock — enqueue latency never
+includes device time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+
+from .engine import EpisodeRequest, ServingEngine
+
+
+class _Group:
+    """Pending episodes of one bucket + the oldest-request deadline."""
+
+    __slots__ = ("episodes", "futures", "deadline")
+
+    def __init__(self, deadline: float):
+        self.episodes: list[EpisodeRequest] = []
+        self.futures: list[Future] = []
+        self.deadline = deadline
+
+
+class MicroBatcher:
+    """Collates concurrent same-bucket episodes into engine dispatches."""
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+        self.max_batch = engine.config.meta_batch_size
+        self.max_wait_s = engine.config.max_wait_ms / 1e3
+        self._lock = threading.Condition()
+        # Insertion-ordered so ties flush oldest-group-first.
+        self._groups: "OrderedDict[tuple, _Group]" = OrderedDict()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    # Producer side
+    # ------------------------------------------------------------------
+
+    def submit(self, episode: EpisodeRequest) -> Future:
+        """Enqueues one prepared episode; the Future resolves to its
+        ``(T, num_classes)`` logits (or raises the dispatch error)."""
+        future: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            group = self._groups.get(episode.bucket)
+            if group is None:
+                group = _Group(time.monotonic() + self.max_wait_s)
+                self._groups[episode.bucket] = group
+            group.episodes.append(episode)
+            group.futures.append(future)
+            self._lock.notify()
+        return future
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(g.episodes) for g in self._groups.values())
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stops the worker after draining pending groups."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify()
+        self._worker.join(timeout)
+
+    # ------------------------------------------------------------------
+    # Worker side
+    # ------------------------------------------------------------------
+
+    def _take_ready(self) -> list[_Group]:
+        """Pops every group that is full or past deadline (lock held)."""
+        now = time.monotonic()
+        ready = []
+        for key in list(self._groups):
+            group = self._groups[key]
+            if (
+                len(group.episodes) >= self.max_batch
+                or now >= group.deadline
+                or self._closed
+            ):
+                ready.append(self._groups.pop(key))
+        return ready
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while True:
+                    ready = self._take_ready()
+                    if ready or (self._closed and not self._groups):
+                        break
+                    if self._groups:
+                        next_deadline = min(
+                            g.deadline for g in self._groups.values()
+                        )
+                        self._lock.wait(
+                            max(0.0, next_deadline - time.monotonic())
+                        )
+                    else:
+                        self._lock.wait()
+                drained = self._closed and not self._groups
+            for group in ready:
+                self._dispatch(group)
+            if drained and not ready:
+                return
+            if drained and ready:
+                # Dispatched the final drain batch; exit on next loop.
+                with self._lock:
+                    if not self._groups:
+                        return
+
+    def _dispatch(self, group: _Group) -> None:
+        try:
+            results = self.engine.dispatch(group.episodes)
+        except Exception as exc:  # surface to every caller, keep serving
+            for future in group.futures:
+                if not future.cancelled():
+                    future.set_exception(exc)
+            return
+        for future, logits in zip(group.futures, results):
+            if not future.cancelled():
+                future.set_result(logits)

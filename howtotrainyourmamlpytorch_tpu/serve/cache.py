@@ -1,0 +1,97 @@
+"""LRU adapted-params cache keyed by a support-set digest.
+
+The adapt half of a served episode is the expensive half (a full inner-loop
+scan, 5 forward+backward passes through the backbone per the flagship
+config) and is a PURE function of ``(served state, support set)`` — so
+repeat queries against an already-seen support set can skip it entirely and
+pay only the classify forward. That access pattern is the common one in
+few-shot serving: a client registers a support set once (their catalog,
+their handwriting samples, ...) and then streams queries against it.
+
+The digest covers everything the adapted artifact depends on: the raw
+support bytes AND dtype/shape (two different wire dtypes must not collide),
+the labels, the learner family, and a state version that the owner bumps on
+every checkpoint swap — a hot model reload must invalidate the whole cache
+without racing in-flight requests (old entries simply stop being reachable
+because every new digest embeds the new version).
+
+Capacity is counted in EPISODES, not bytes: the artifact size per learner
+is fixed (matching nets: a few KB of embeddings; MAML: the fast-weight
+tree; GD: a full parameter tree), so the owner sizes capacity per learner.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+
+def support_digest(
+    x_support: np.ndarray,
+    y_support: np.ndarray,
+    *,
+    learner: str,
+    state_version: int,
+) -> str:
+    """Content hash of one episode's support set under one served model."""
+    h = hashlib.sha256()
+    h.update(f"{learner}|v{state_version}|".encode())
+    x = np.ascontiguousarray(x_support)
+    y = np.ascontiguousarray(y_support)
+    h.update(str(x.dtype).encode() + b"|" + str(x.shape).encode() + b"|")
+    h.update(x.tobytes())
+    h.update(str(y.dtype).encode() + b"|" + str(y.shape).encode() + b"|")
+    h.update(y.tobytes())
+    return h.hexdigest()
+
+
+class AdaptedParamsCache:
+    """Thread-safe LRU over adapted-params pytrees.
+
+    ``get`` refreshes recency; ``put`` evicts the least-recently-used entry
+    past capacity. Entries are opaque to the cache (device-array pytrees) —
+    eviction drops the Python reference and lets the runtime free the
+    device buffers.
+    """
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, Any] = OrderedDict()
+        self.evictions = 0
+
+    def get(self, digest: str):
+        """The cached artifact, or None. Refreshes LRU recency on hit."""
+        with self._lock:
+            if digest not in self._entries:
+                return None
+            self._entries.move_to_end(digest)
+            return self._entries[digest]
+
+    def put(self, digest: str, artifact: Any) -> None:
+        if self.capacity == 0:
+            return
+        with self._lock:
+            self._entries[digest] = artifact
+            self._entries.move_to_end(digest)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, digest: str) -> bool:
+        with self._lock:
+            return digest in self._entries

@@ -1,0 +1,354 @@
+"""Shape-bucketed adapt+classify execution engine.
+
+The serving counterpart of the training step cache: every learner exposes
+two per-task pure functions (``serve_adapt``: support set -> adapted
+params; ``serve_classify``: adapted params + queries -> logits), and the
+engine jits their task-vmapped forms ONCE each. Shape bucketing then falls
+out of jax's compilation cache: a request class is the shape signature
+``(meta_batch, n_support, n_query)``, and the engine pins the signature set
+small by
+
+* keying episodes into ``(way, shot, query)`` buckets — one compiled
+  adapt/classify program pair per bucket (buckets that coincide in raw
+  shape share the XLA executable via the jit cache);
+* padding the TASK axis of every dispatch to the fixed
+  ``ServeConfig.meta_batch_size`` — the axis concurrency varies on (1
+  episode in a quiet second, 8 in a burst) — so traffic level can never
+  mint new signatures. Task padding is bit-exact: the task axis is
+  ``jax.vmap``'d, tasks are computationally independent, and
+  ``tests/test_serve_parity.py`` pins the padded path against
+  ``run_validation_iter`` for all three learners.
+
+Steady state is therefore ZERO per-request recompiles — the contract
+``utils/sanitize.compile_guard`` enforces in
+``tests/test_serve_runtime.py``, and the engine's own compile table (one
+trace-time counter per program x signature) is exported at ``/metrics`` so
+a production recompile regression is visible on a dashboard, not just in CI.
+
+The adapted-params cache (``serve/cache.py``) keys on a support-set digest:
+hits skip the adapt program entirely and pay only classify. Both stages are
+timed per dispatch into the latency histograms (``serve/metrics.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.common import encode_images
+from .cache import AdaptedParamsCache, support_digest
+from .metrics import ServeMetrics
+
+Tree = Any
+
+#: learner class name -> the short family name used in program names,
+#: cache digests, and metric labels.
+_LEARNER_FAMILIES = {
+    "MAMLFewShotLearner": "maml",
+    "GradientDescentLearner": "gradient_descent",
+    "MatchingNetsLearner": "matching_nets",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static serving-runtime knobs (CLI surface: ``tools/serve_maml.py``)."""
+
+    #: Fixed task axis of every dispatch. The throughput lever: concurrent
+    #: episodes in the same bucket ride one device program. Also the compile
+    #: contract — every dispatch pads to exactly this many tasks.
+    meta_batch_size: int = 4
+    #: Micro-batching window (serve/batcher.py): a request waits at most
+    #: this long for co-batchable traffic before its bucket is flushed.
+    max_wait_ms: float = 2.0
+    #: Adapted-params cache capacity, in episodes. 0 disables caching.
+    cache_capacity: int = 256
+
+    def __post_init__(self):
+        if self.meta_batch_size < 1:
+            raise ValueError(
+                f"meta_batch_size must be >= 1, got {self.meta_batch_size}"
+            )
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+
+
+@dataclasses.dataclass
+class EpisodeRequest:
+    """One prepared episode: wire-format arrays + bucket identity."""
+
+    x_support: np.ndarray  # (S, C, H, W), wire dtype
+    y_support: np.ndarray  # (S,), int32
+    x_query: np.ndarray  # (T, C, H, W), wire dtype
+    way: int
+    shot: int
+    digest: str
+
+    @property
+    def bucket(self) -> tuple[int, int, int]:
+        return (self.way, self.shot, int(self.x_query.shape[0]))
+
+
+class ServingEngine:
+    """Owns the served state, the compiled program pair, and the cache."""
+
+    def __init__(
+        self,
+        learner,
+        state,
+        config: ServeConfig | None = None,
+        metrics: ServeMetrics | None = None,
+    ):
+        self.learner = learner
+        self.config = config or ServeConfig()
+        self.metrics = metrics or ServeMetrics()
+        self.family = _LEARNER_FAMILIES.get(
+            type(learner).__name__, type(learner).__name__.lower()
+        )
+        self.cache = AdaptedParamsCache(self.config.cache_capacity)
+        self.state_version = 0
+        self._istate = learner.inference_state(state)
+        self._compiles: dict[str, int] = {}
+        self._compiles_lock = threading.Lock()
+        self._adapt, self._classify = self._build_programs()
+
+    # ------------------------------------------------------------------
+    # Compiled programs
+    # ------------------------------------------------------------------
+
+    def _note_trace(self, label: str) -> None:
+        # Runs at TRACE time only (inside the jitted python body), i.e.
+        # exactly once per new shape signature — the per-bucket compile
+        # table /metrics exports. Intentional trace-time side effect.
+        with self._compiles_lock:
+            self._compiles[label] = self._compiles.get(label, 0) + 1
+
+    def _build_programs(self):
+        learner = self.learner
+        note = self._note_trace
+        adapt_vm = jax.vmap(learner.serve_adapt, in_axes=(None, 0, 0))
+        classify_vm = jax.vmap(learner.serve_classify, in_axes=(None, 0, 0))
+
+        def adapt_batched(istate, x_support, y_support):
+            note(
+                "adapt:"
+                + "x".join(str(d) for d in x_support.shape[:2])
+            )
+            return adapt_vm(istate, x_support, y_support)
+
+        def classify_batched(istate, adapted, x_query):
+            note(
+                "classify:"
+                + "x".join(str(d) for d in x_query.shape[:2])
+            )
+            return classify_vm(istate, adapted, x_query)
+
+        adapt_batched.__name__ = f"serve_adapt_{self.family}"
+        adapt_batched.__qualname__ = adapt_batched.__name__
+        classify_batched.__name__ = f"serve_classify_{self.family}"
+        classify_batched.__qualname__ = classify_batched.__name__
+        return jax.jit(adapt_batched), jax.jit(classify_batched)
+
+    def compile_table(self) -> dict[str, int]:
+        with self._compiles_lock:
+            return dict(self._compiles)
+
+    # ------------------------------------------------------------------
+    # State management
+    # ------------------------------------------------------------------
+
+    def update_state(self, state) -> int:
+        """Hot-swaps the served checkpoint. Bumping ``state_version``
+        invalidates every cached adapted artifact WITHOUT racing in-flight
+        requests — new digests embed the new version, old entries age out
+        of the LRU. Returns the new version."""
+        self._istate = self.learner.inference_state(state)
+        self.state_version += 1
+        self.cache.clear()
+        return self.state_version
+
+    # ------------------------------------------------------------------
+    # Request preparation
+    # ------------------------------------------------------------------
+
+    def prepare_episode(self, x_support, y_support, x_query) -> EpisodeRequest:
+        """Validates + wire-encodes one raw episode.
+
+        Accepts ``(way, shot, C, H, W)`` / ``(T, C, H, W)`` structured or
+        already-flat support/query image arrays; labels flat ``(S,)`` or
+        ``(way, shot)``. Raises ``ValueError`` on image/label shapes the
+        served model cannot answer for — malformed requests must fail at
+        the front door, not inside a compiled program."""
+        bb = self.learner.cfg.backbone
+        expect = (bb.image_channels, bb.image_height, bb.image_width)
+
+        def flat_images(arr, name):
+            arr = np.asarray(arr, np.float32)
+            if arr.ndim < 4:
+                arr = arr.reshape((-1,) + expect)  # raises on element mismatch
+            else:
+                arr = arr.reshape((-1,) + arr.shape[-3:])
+            if arr.shape[1:] != expect:
+                raise ValueError(
+                    f"{name} images have shape {arr.shape[1:]}, the served "
+                    f"model expects {expect}"
+                )
+            return arr
+
+        xs = flat_images(x_support, "support")
+        xq = flat_images(x_query, "query")
+        ys = np.asarray(y_support, np.int32).reshape(-1)
+        if ys.shape[0] != xs.shape[0]:
+            raise ValueError(
+                f"{ys.shape[0]} support labels for {xs.shape[0]} support "
+                "images"
+            )
+        if xs.shape[0] < 1:
+            raise ValueError(
+                "episode has no support images — a 0-row support set would "
+                "adapt on a mean-of-empty (NaN) loss"
+            )
+        if xq.shape[0] < 1:
+            raise ValueError("episode has no query images")
+        if ys.min() < 0 or int(ys.max()) >= bb.num_classes:
+            raise ValueError(
+                f"support labels must lie in [0, {bb.num_classes}) for the "
+                "served head"
+            )
+        # Class-uniform episode structure: every class 0..way-1 present with
+        # the SAME shot count. This is what makes (way, shot) a well-defined
+        # SHAPE class — without it, two valid-looking episodes could share a
+        # bucket with different support counts and crash the whole co-batched
+        # dispatch group at np.stack.
+        way = int(ys.max()) + 1
+        counts = np.bincount(ys, minlength=way)
+        if counts.min() != counts.max():
+            raise ValueError(
+                "support set must be class-uniform (every class the same "
+                f"shot count); got per-class counts {counts.tolist()}"
+            )
+        shot = int(counts[0])
+        codec = self.learner.cfg.wire_codec
+        if codec is not None:
+            xs, xq = encode_images(xs, codec), encode_images(xq, codec)
+        digest = support_digest(
+            xs, ys, learner=self.family, state_version=self.state_version
+        )
+        return EpisodeRequest(
+            x_support=xs, y_support=ys, x_query=xq,
+            way=way, shot=shot, digest=digest,
+        )
+
+    # ------------------------------------------------------------------
+    # Dispatch
+    # ------------------------------------------------------------------
+
+    def dispatch(self, episodes: Sequence[EpisodeRequest]) -> list[np.ndarray]:
+        """Runs a group of SAME-BUCKET episodes as padded meta-batch
+        dispatches; returns per-episode ``(T, num_classes)`` float32 logits
+        in input order. Groups larger than ``meta_batch_size`` are chunked."""
+        if not episodes:
+            return []
+        bucket = episodes[0].bucket
+        for ep in episodes[1:]:
+            if ep.bucket != bucket:
+                raise ValueError(
+                    f"mixed buckets in one dispatch: {ep.bucket} vs {bucket}"
+                    " (the batcher groups by bucket; direct callers must too)"
+                )
+        out: list[np.ndarray] = []
+        chunk = self.config.meta_batch_size
+        for start in range(0, len(episodes), chunk):
+            out.extend(self._dispatch_chunk(episodes[start : start + chunk]))
+        return out
+
+    def _pad_rows(self, arrays: list[np.ndarray]) -> np.ndarray:
+        """Stacks per-episode arrays into the fixed (meta_batch, ...) layout,
+        repeating row 0 into the padding tasks (vmap independence makes any
+        well-formed filler equivalent; row 0 is always present)."""
+        b = self.config.meta_batch_size
+        pad = b - len(arrays)
+        stacked = np.stack(arrays + [arrays[0]] * pad)
+        return stacked
+
+    def _dispatch_chunk(self, eps: Sequence[EpisodeRequest]) -> list[np.ndarray]:
+        b = self.config.meta_batch_size
+        # One state snapshot for BOTH stages: a concurrent update_state must
+        # never split a dispatch across checkpoint versions (new frozen
+        # params classifying old fast weights).
+        istate = self._istate
+        self.metrics.batches_dispatched.inc()
+        self.metrics.padded_tasks.inc(b - len(eps))
+        self.metrics.record_bucket_dispatch(eps[0].bucket, len(eps))
+
+        # --- adapt (cache misses only) ---------------------------------
+        artifacts: list[Tree | None] = [None] * len(eps)
+        miss: list[int] = []
+        for i, ep in enumerate(eps):
+            cached = self.cache.get(ep.digest)
+            if cached is None:
+                miss.append(i)
+            else:
+                artifacts[i] = cached
+        self.metrics.cache_hits.inc(len(eps) - len(miss))
+        self.metrics.cache_misses.inc(len(miss))
+        if miss:
+            xs = self._pad_rows([eps[i].x_support for i in miss])
+            ys = self._pad_rows([eps[i].y_support for i in miss])
+            t0 = time.perf_counter()
+            adapted = self._adapt(istate, xs, ys)
+            adapted = jax.block_until_ready(adapted)
+            self.metrics.adapt_latency.observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+            for row, i in enumerate(miss):
+                artifact = jax.tree.map(lambda a: a[row], adapted)
+                artifacts[i] = artifact
+                self.cache.put(eps[i].digest, artifact)
+
+        # --- classify (all episodes) -----------------------------------
+        pad = b - len(eps)
+        padded_artifacts = list(artifacts) + [artifacts[0]] * pad
+        stacked = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves), *padded_artifacts
+        )
+        xq = self._pad_rows([ep.x_query for ep in eps])
+        t0 = time.perf_counter()
+        logits = self._classify(istate, stacked, xq)
+        logits = jax.block_until_ready(logits)
+        self.metrics.classify_latency.observe((time.perf_counter() - t0) * 1e3)
+        host = np.asarray(logits)
+        self.metrics.episodes_served.inc(len(eps))
+        return [host[i] for i in range(len(eps))]
+
+    # ------------------------------------------------------------------
+    # Warmup
+    # ------------------------------------------------------------------
+
+    def warmup(self, buckets: Sequence[tuple[int, int, int]]) -> None:
+        """Pre-compiles the program pair for each declared ``(way, shot,
+        query)`` bucket so first-request latency is a dispatch, not an XLA
+        compile. Bypasses the cache (zero-image warmup episodes must not
+        occupy capacity or answer a real all-zero request)."""
+        bb = self.learner.cfg.backbone
+        for way, shot, query in buckets:
+            way = min(int(way), bb.num_classes)
+            img = (bb.image_channels, bb.image_height, bb.image_width)
+            xs = np.zeros((way * shot,) + img, np.float32)
+            ys = np.asarray(
+                [c for c in range(way) for _ in range(shot)], np.int32
+            )
+            xq = np.zeros((query,) + img, np.float32)
+            ep = self.prepare_episode(xs, ys, xq)
+            xs_b = self._pad_rows([ep.x_support])
+            ys_b = self._pad_rows([ep.y_support])
+            adapted = self._adapt(self._istate, xs_b, ys_b)
+            self._classify(self._istate, adapted, self._pad_rows([ep.x_query]))

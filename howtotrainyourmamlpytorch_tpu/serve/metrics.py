@@ -1,0 +1,196 @@
+"""Serving observability: latency quantiles, counters, Prometheus text.
+
+Small and dependency-free by design (the container bakes no metrics client).
+Latency percentiles are computed EXACTLY over a bounded ring of recent
+samples rather than approximated from fixed histogram buckets — at serving
+rates the ring covers minutes of traffic, and the bench keys
+(``serve_adapt_p50_ms``; PERF_NOTES.md "Serving path") need real medians,
+not bucket midpoints. Cumulative ``count``/``sum`` still cover the full
+process lifetime, so rate math over scrapes stays correct.
+
+Everything here is thread-safe: the HTTP frontend scrapes ``/metrics`` from
+its own threads while batcher/engine threads record.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class LatencyStat:
+    """Cumulative count/sum plus exact percentiles over a recent window."""
+
+    def __init__(self, name: str, window: int = 2048):
+        self.name = name
+        self._lock = threading.Lock()
+        self._recent: deque[float] = deque(maxlen=window)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, value_ms: float) -> None:
+        with self._lock:
+            self._recent.append(float(value_ms))
+            self._count += 1
+            self._sum += float(value_ms)
+
+    def percentile(self, p: float) -> float:
+        """Exact percentile (nearest-rank) of the recent window; 0.0 when
+        empty."""
+        with self._lock:
+            if not self._recent:
+                return 0.0
+            ordered = sorted(self._recent)
+        rank = min(len(ordered) - 1, max(0, round(p / 100.0 * (len(ordered) - 1))))
+        return ordered[rank]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self._count, self._sum
+        return {
+            "count": count,
+            "sum_ms": total,
+            "p50_ms": self.percentile(50),
+            "p99_ms": self.percentile(99),
+        }
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self._value += by
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class ServeMetrics:
+    """The serving runtime's metric registry (one per engine).
+
+    ``render_prometheus`` emits the text exposition format `/metrics`
+    serves; ``snapshot`` returns the same data as a dict for the in-process
+    API and the bench harness.
+    """
+
+    PREFIX = "maml_serve"
+
+    def __init__(self):
+        self.adapt_latency = LatencyStat("adapt")
+        self.classify_latency = LatencyStat("classify")
+        self.request_latency = LatencyStat("request")
+        self.requests_total = Counter("requests_total")
+        self.request_errors = Counter("request_errors")
+        self.episodes_served = Counter("episodes_served")
+        self.cache_hits = Counter("cache_hits")
+        self.cache_misses = Counter("cache_misses")
+        self.batches_dispatched = Counter("batches_dispatched")
+        self.padded_tasks = Counter("padded_tasks")
+        # bucket key -> {"dispatches": int, "episodes": int}; compile counts
+        # live with the engine (it owns the jit boundary) and are merged
+        # into snapshots by the caller.
+        self._lock = threading.Lock()
+        self._buckets: dict[tuple, dict] = {}
+
+    def record_bucket_dispatch(self, key: tuple, episodes: int) -> None:
+        with self._lock:
+            row = self._buckets.setdefault(
+                key, {"dispatches": 0, "episodes": 0}
+            )
+            row["dispatches"] += 1
+            row["episodes"] += episodes
+
+    def bucket_table(self) -> dict[tuple, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._buckets.items()}
+
+    def cache_hit_rate(self) -> float:
+        hits, misses = self.cache_hits.value, self.cache_misses.value
+        total = hits + misses
+        return hits / total if total else 0.0
+
+    def snapshot(self, *, queue_depth: int = 0, compile_table: dict | None = None):
+        """``compile_table``: ``{program_label: trace_count}`` from the
+        engine (it owns the jit boundary and counts actual retraces)."""
+        return {
+            "requests_total": self.requests_total.value,
+            "request_errors": self.request_errors.value,
+            "episodes_served": self.episodes_served.value,
+            "batches_dispatched": self.batches_dispatched.value,
+            "padded_tasks": self.padded_tasks.value,
+            "queue_depth": queue_depth,
+            "cache": {
+                "hits": self.cache_hits.value,
+                "misses": self.cache_misses.value,
+                "hit_rate": self.cache_hit_rate(),
+            },
+            "latency_ms": {
+                "adapt": self.adapt_latency.snapshot(),
+                "classify": self.classify_latency.snapshot(),
+                "request": self.request_latency.snapshot(),
+            },
+            "buckets": {
+                "x".join(str(d) for d in key): dict(row)
+                for key, row in self.bucket_table().items()
+            },
+            "compiles": dict(compile_table or {}),
+        }
+
+    def render_prometheus(
+        self, *, queue_depth: int = 0, compile_table: dict | None = None
+    ) -> str:
+        p = self.PREFIX
+        lines = [
+            f"# TYPE {p}_requests_total counter",
+            f"{p}_requests_total {self.requests_total.value}",
+            f"# TYPE {p}_request_errors_total counter",
+            f"{p}_request_errors_total {self.request_errors.value}",
+            f"# TYPE {p}_episodes_served_total counter",
+            f"{p}_episodes_served_total {self.episodes_served.value}",
+            f"# TYPE {p}_batches_dispatched_total counter",
+            f"{p}_batches_dispatched_total {self.batches_dispatched.value}",
+            f"# TYPE {p}_padded_tasks_total counter",
+            f"{p}_padded_tasks_total {self.padded_tasks.value}",
+            f"# TYPE {p}_queue_depth gauge",
+            f"{p}_queue_depth {queue_depth}",
+            f"# TYPE {p}_cache_hits_total counter",
+            f"{p}_cache_hits_total {self.cache_hits.value}",
+            f"# TYPE {p}_cache_misses_total counter",
+            f"{p}_cache_misses_total {self.cache_misses.value}",
+            f"# TYPE {p}_cache_hit_rate gauge",
+            f"{p}_cache_hit_rate {self.cache_hit_rate():.6f}",
+        ]
+        for stage, stat in (
+            ("adapt", self.adapt_latency),
+            ("classify", self.classify_latency),
+            ("request", self.request_latency),
+        ):
+            snap = stat.snapshot()
+            lines += [
+                f"# TYPE {p}_{stage}_latency_ms summary",
+                f'{p}_{stage}_latency_ms{{quantile="0.5"}} '
+                f"{snap['p50_ms']:.6f}",
+                f'{p}_{stage}_latency_ms{{quantile="0.99"}} '
+                f"{snap['p99_ms']:.6f}",
+                f"{p}_{stage}_latency_ms_count {snap['count']}",
+                f"{p}_{stage}_latency_ms_sum {snap['sum_ms']:.6f}",
+            ]
+        lines.append(f"# TYPE {p}_bucket_episodes_total counter")
+        for key, row in sorted(self.bucket_table().items()):
+            label = "x".join(str(d) for d in key)
+            lines.append(
+                f'{p}_bucket_episodes_total{{bucket="{label}"}} '
+                f"{row['episodes']}"
+            )
+        lines.append(f"# TYPE {p}_program_compiles counter")
+        for label, count in sorted((compile_table or {}).items()):
+            lines.append(
+                f'{p}_program_compiles{{program="{label}"}} {count}'
+            )
+        return "\n".join(lines) + "\n"

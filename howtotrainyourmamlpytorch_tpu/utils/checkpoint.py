@@ -255,6 +255,60 @@ def _verify_manifest(filepath: str, manifest: dict, leaves: dict, exp_bytes: byt
             )
 
 
+def _read_verified(filepath: str, retries: int, backoff_s: float):
+    """Reads + integrity-verifies an archive with the transient-I/O retry
+    contract shared by every loader: ``CheckpointCorruptError`` for
+    integrity failures (quarantinable), plain ``CheckpointError`` after the
+    retry budget for persistent I/O errors (NOT the corrupt subtype, so a
+    brief NFS outage can never cascade-quarantine healthy checkpoints).
+    Returns ``(leaves, manifest_or_None, experiment_state)``."""
+    last_io_error: OSError | None = None
+    for attempt in range(max(int(retries), 1)):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            leaves, exp_bytes, manifest = _read_archive(filepath)
+            if manifest is not None:
+                _verify_manifest(filepath, manifest, leaves, exp_bytes)
+            return leaves, manifest, json.loads(exp_bytes.decode())
+        except CheckpointError:
+            raise
+        except FileNotFoundError as exc:
+            # Deterministic, not transient: the named checkpoint is gone.
+            raise CheckpointCorruptError(
+                f"{filepath}: checkpoint file does not exist"
+            ) from exc
+        except OSError as exc:  # transient I/O: retry, never quarantine
+            last_io_error = exc
+        except Exception as exc:  # zipfile/EOFError/KeyError/json errors
+            raise CheckpointCorruptError(
+                f"{filepath}: unreadable checkpoint archive "
+                f"({type(exc).__name__}: {exc})"
+            ) from exc
+    raise CheckpointError(
+        f"{filepath}: read failed {max(int(retries), 1)} times "
+        f"({type(last_io_error).__name__}: {last_io_error}) — transient "
+        "I/O failure, not corruption; refusing to quarantine"
+    ) from last_io_error
+
+
+def _restore_prefix(filepath: str, template_leaves: list, leaves: dict) -> list:
+    """Casts archive leaves ``0..len(template)-1`` onto the template's
+    shapes/dtypes; ``ValueError`` on any shape mismatch (a checkpoint from
+    a different config/architecture — never a silent misload)."""
+    restored = []
+    for i, tmpl in enumerate(template_leaves):
+        tmpl_arr = np.asarray(tmpl)
+        leaf = leaves[f"leaf_{i}"]
+        if tmpl_arr.shape != leaf.shape:
+            raise ValueError(
+                f"{filepath}: checkpoint leaf {i} shape {leaf.shape} != "
+                f"expected {tmpl_arr.shape} (config/architecture mismatch?)"
+            )
+        restored.append(leaf.astype(tmpl_arr.dtype))
+    return restored
+
+
 def load_checkpoint(
     filepath: str,
     template_tree: Tree,
@@ -277,36 +331,9 @@ def load_checkpoint(
     structural checks only."""
     template_leaves, treedef = jax.tree.flatten(template_tree)
     n_template = len(template_leaves)
-    last_io_error: OSError | None = None
-    for attempt in range(max(int(retries), 1)):
-        if attempt:
-            time.sleep(backoff_s * (2 ** (attempt - 1)))
-        try:
-            leaves, exp_bytes, manifest = _read_archive(filepath)
-            if manifest is not None:
-                _verify_manifest(filepath, manifest, leaves, exp_bytes)
-            experiment_state = json.loads(exp_bytes.decode())
-            break
-        except CheckpointError:
-            raise
-        except FileNotFoundError as exc:
-            # Deterministic, not transient: the named checkpoint is gone.
-            raise CheckpointCorruptError(
-                f"{filepath}: checkpoint file does not exist"
-            ) from exc
-        except OSError as exc:  # transient I/O: retry, never quarantine
-            last_io_error = exc
-        except Exception as exc:  # zipfile/EOFError/KeyError/json errors
-            raise CheckpointCorruptError(
-                f"{filepath}: unreadable checkpoint archive "
-                f"({type(exc).__name__}: {exc})"
-            ) from exc
-    else:
-        raise CheckpointError(
-            f"{filepath}: read failed {max(int(retries), 1)} times "
-            f"({type(last_io_error).__name__}: {last_io_error}) — transient "
-            "I/O failure, not corruption; refusing to quarantine"
-        ) from last_io_error
+    leaves, manifest, experiment_state = _read_verified(
+        filepath, retries, backoff_s
+    )
 
     if len(leaves) != n_template:
         raise ValueError(
@@ -323,14 +350,51 @@ def load_checkpoint(
             "(config/architecture change?)"
         )
 
-    restored = []
-    for i, tmpl in enumerate(template_leaves):
-        tmpl_arr = np.asarray(tmpl)
-        leaf = leaves[f"leaf_{i}"]
-        if tmpl_arr.shape != leaf.shape:
-            raise ValueError(
-                f"checkpoint leaf {i} shape {leaf.shape} != expected"
-                f" {tmpl_arr.shape} (config/architecture mismatch?)"
-            )
-        restored.append(leaf.astype(tmpl_arr.dtype))
+    restored = _restore_prefix(filepath, template_leaves, leaves)
+    return jax.tree.unflatten(treedef, restored), experiment_state
+
+
+def load_for_inference(
+    filepath: str,
+    template_tree: Tree,
+    *,
+    retries: int = READ_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> tuple[Tree, dict]:
+    """Restores the params+BN-stats PREFIX of a full training checkpoint —
+    the serving cold-start load (``serve/``).
+
+    ``template_tree`` is a learner ``init_inference_state`` tree
+    (``MAMLInferenceState`` / ``InferenceState``): the leading fields of the
+    train state in flatten order, WITHOUT the optimizer state — so a serving
+    process never constructs (or pays host RAM for) the Adam moment trees,
+    which for these models are 2x the parameter bytes. Checkpoint leaves are
+    stored flat in flatten order, and every learner's inference state is a
+    strict field PREFIX of its train state, so the first ``len(template)``
+    leaves are exactly the serving slice.
+
+    Integrity semantics match ``load_checkpoint``: the FULL archive manifest
+    is verified (every leaf CRC, experiment-state CRC — a torn write in the
+    optimizer region still refuses to serve), ``CheckpointCorruptError`` for
+    integrity failures, ``ValueError`` for structural mismatches (template
+    needs more leaves than the archive holds, or a prefix-leaf shape
+    mismatch — a checkpoint from a different architecture), and transient
+    read ``OSError`` retried then surfaced as plain ``CheckpointError``.
+    The full-tree fingerprint check is necessarily skipped (computing it
+    would require the optimizer template this loader exists to avoid);
+    prefix leaf-count + per-leaf shape checks stand in for it.
+    """
+    template_leaves, treedef = jax.tree.flatten(template_tree)
+    n_template = len(template_leaves)
+    leaves, _manifest, experiment_state = _read_verified(
+        filepath, retries, backoff_s
+    )
+
+    if len(leaves) < n_template:
+        raise ValueError(
+            f"{filepath}: checkpoint has {len(leaves)} leaves but the "
+            f"inference template needs {n_template} — config/architecture "
+            "mismatch (refusing to load by truncation)"
+        )
+    restored = _restore_prefix(filepath, template_leaves, leaves)
     return jax.tree.unflatten(treedef, restored), experiment_state

@@ -270,3 +270,82 @@ def test_publish_alias_is_loadable_and_hardlinked(tmp_path):
     # The epoch-7 file is untouched by the re-publish.
     _, exp = load_checkpoint(epoch_path, _tree(0))
     assert exp["current_iter"] == 7
+
+
+# ---------------------------------------------------------------------------
+# load_for_inference (ISSUE 4 satellite: serving cold-start load)
+# ---------------------------------------------------------------------------
+#
+# Codec-level contract: the PREFIX of the flat leaf sequence restores
+# against a shorter template (the learners' InferenceState trees are field
+# prefixes of their train states), the FULL archive manifest is still
+# verified, and the typed-error split (CheckpointCorruptError vs
+# ValueError) is preserved. End-to-end learner coverage (serve-from-loaded
+# bit-exactness) lives in tests/test_serve_parity.py.
+
+
+def _list_tree(seed=0, n=4, size=6):
+    rng = np.random.RandomState(seed)
+    return [rng.rand(size, 2).astype(np.float32) for _ in range(n)]
+
+
+def test_load_for_inference_restores_prefix(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        load_for_inference,
+    )
+
+    path = str(tmp_path / "ckpt")
+    full = _list_tree(seed=3, n=4)
+    save_checkpoint(path, full, {"current_iter": 11})
+    restored, exp = load_for_inference(path, _list_tree(seed=9, n=2))
+    assert exp == {"current_iter": 11}
+    assert len(restored) == 2
+    for got, want in zip(restored, full[:2]):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_load_for_inference_verifies_manifest_beyond_the_prefix(tmp_path):
+    """A bit-flip in a leaf OUTSIDE the inference prefix still refuses the
+    load — integrity is all-or-nothing, a torn write anywhere means the
+    file cannot be trusted."""
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        load_for_inference,
+    )
+
+    path = str(tmp_path / "ckpt")
+    marker = np.full((64,), 7.6543215, np.float32)
+    save_checkpoint(path, [np.ones((3,), np.float32), marker], {})
+    with open(path, "rb") as f:
+        blob = f.read()
+    offset = blob.find(marker.tobytes())
+    assert offset > 0
+    with open(path, "r+b") as f:
+        f.seek(offset + 9)
+        byte = f.read(1)
+        f.seek(offset + 9)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorruptError):
+        load_for_inference(path, [np.ones((3,), np.float32)])
+
+
+def test_load_for_inference_typed_errors(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        load_for_inference,
+    )
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, _list_tree(n=3), {})
+    # architecture mismatch: prefix leaf shape differs -> ValueError
+    with pytest.raises(ValueError, match="shape"):
+        load_for_inference(path, _list_tree(n=2, size=9))
+    # template larger than the archive -> ValueError, never truncation
+    with pytest.raises(ValueError, match="leaves"):
+        load_for_inference(path, _list_tree(n=5))
+    # missing file -> typed corrupt (resume paths may fall back)
+    with pytest.raises(CheckpointCorruptError):
+        load_for_inference(str(tmp_path / "nope"), _list_tree(n=2))
+    # truncated archive -> typed corrupt
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointCorruptError):
+        load_for_inference(path, _list_tree(n=2))

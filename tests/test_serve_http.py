@@ -1,0 +1,179 @@
+"""HTTP frontend smoke (tier-1, CPU-only, tiny shapes): boot the server on
+an ephemeral port, round-trip one episode, scrape ``/metrics``, shut down
+cleanly. Plus the route/validation surface and the ``tools/serve_maml.py``
+CLI plumbing (config-JSON learner build, warmup-spec parsing)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.serve import (
+    ServeConfig,
+    ServingAPI,
+    make_http_server,
+)
+
+
+def tiny_cfg():
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            image_height=8,
+            image_width=8,
+            num_classes=5,
+            per_step_bn_statistics=True,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+    )
+
+
+@pytest.fixture
+def served():
+    """A running HTTP server over a tiny fresh-init learner; yields
+    ``(base_url, api)`` and guarantees clean shutdown."""
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    api = ServingAPI(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=1.0)
+    )
+    server = make_http_server(api, port=0)  # ephemeral port
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{port}", api
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+        api.close()
+        assert not thread.is_alive(), "server thread must exit on shutdown"
+
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, json.load(resp)
+
+
+def post_episode(base, payload):
+    req = urllib.request.Request(
+        f"{base}/v1/episode",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.load(resp)
+
+
+def episode_payload(rng, way=5, shot=1, query=2):
+    return {
+        "support": rng.rand(way * shot, 1, 8, 8).tolist(),
+        "support_labels": np.repeat(np.arange(way), shot).tolist(),
+        "query": rng.rand(query, 1, 8, 8).tolist(),
+    }
+
+
+def test_http_roundtrip_and_metrics_scrape(served, rng):
+    base, api = served
+    status, health = get_json(f"{base}/healthz")
+    assert status == 200
+    assert health["status"] == "ok" and health["family"] == "maml"
+
+    status, body = post_episode(base, episode_payload(rng))
+    assert status == 200
+    logits = np.asarray(body["logits"], np.float32)
+    assert logits.shape == (2, 5)
+    assert body["bucket"] == "5x1x2"
+    assert body["cache_hit"] is False
+    assert body["predictions"] == np.argmax(logits, axis=-1).tolist()
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+        assert resp.status == 200
+        text = resp.read().decode()
+    assert "maml_serve_requests_total 1" in text
+    assert 'maml_serve_adapt_latency_ms{quantile="0.5"}' in text
+    assert 'maml_serve_adapt_latency_ms{quantile="0.99"}' in text
+    assert "maml_serve_cache_hit_rate" in text
+    assert "maml_serve_queue_depth" in text
+    assert 'maml_serve_bucket_episodes_total{bucket="5x1x2"} 1' in text
+    assert 'maml_serve_program_compiles{program="adapt:2x5"} 1' in text
+
+
+def test_http_cache_hit_on_repeat_support(served, rng):
+    base, _ = served
+    payload = episode_payload(rng)
+    _, first = post_episode(base, payload)
+    _, second = post_episode(base, payload)
+    assert first["cache_hit"] is False
+    assert second["cache_hit"] is True
+    assert second["logits"] == first["logits"]
+
+
+def test_http_error_surface(served, rng):
+    base, _ = served
+    # unknown route -> 404
+    with pytest.raises(urllib.error.HTTPError) as err:
+        get_json(f"{base}/nope")
+    assert err.value.code == 404
+    # malformed episode -> 400 with the validation message
+    bad = episode_payload(rng)
+    bad["support_labels"] = bad["support_labels"][:-1]
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post_episode(base, bad)
+    assert err.value.code == 400
+    assert "support labels" in json.load(err.value)["error"]
+    # missing field -> 400, not a hang or a 500
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post_episode(base, {"support": []})
+    assert err.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# serve_maml CLI plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_cli_builds_learner_from_experiment_config(tmp_path, monkeypatch):
+    from tools.serve_maml import build_learner
+
+    monkeypatch.setenv("DATASET_DIR", str(tmp_path))
+    cfg_json = {
+        "num_stages": 2,
+        "cnn_num_filters": 4,
+        "num_classes_per_set": 5,
+        "image_height": 8,
+        "image_width": 8,
+        "image_channels": 1,
+        "per_step_bn_statistics": True,
+        "number_of_training_steps_per_iter": 2,
+        "number_of_evaluation_steps_per_iter": 2,
+    }
+    path = tmp_path / "serve_cfg.json"
+    path.write_text(json.dumps(cfg_json))
+    learner = build_learner("maml", str(path))
+    assert isinstance(learner, MAMLFewShotLearner)
+    assert learner.cfg.backbone.num_filters == 4
+    assert learner.cfg.backbone.num_classes == 5
+    assert learner.cfg.number_of_training_steps_per_iter == 2
+
+
+def test_cli_warmup_spec_parsing():
+    from tools.serve_maml import parse_warmup
+
+    assert parse_warmup("5x1x15,20x1x5") == [(5, 1, 15), (20, 1, 5)]
+    assert parse_warmup("") == []
+    with pytest.raises(ValueError, match="WAYxSHOTxQUERY"):
+        parse_warmup("5x1")

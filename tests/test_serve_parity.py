@@ -1,0 +1,202 @@
+"""Served predictions are BIT-EXACT with the eval harness.
+
+The acceptance contract of the serving runtime (ISSUE 4): for a golden
+fixture episode (labels from the recorded reference-sampler fixtures in
+``tests/fixtures/``, images seeded from the episode's recorded seed), the
+logits answered by the full serving path — request preparation, shape
+bucketing, TASK-AXIS PADDING to the engine's fixed meta-batch, the split
+adapt/classify program pair, the adapted-params cache — are bitwise equal
+to ``run_validation_iter``'s for all three learner families.
+
+Ordering note: the GD eval step donates its input state buffers, so every
+test runs the serving path FIRST and the reference eval last.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    GradientDescentLearner,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    MatchingNetsLearner,
+)
+from howtotrainyourmamlpytorch_tpu.models.common import WireCodec
+from howtotrainyourmamlpytorch_tpu.serve import ServeConfig, ServingAPI
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    load_for_inference,
+    save_checkpoint,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "reference_episodes.json"
+)
+
+LEARNER_CLASSES = {
+    "maml": MAMLFewShotLearner,
+    "gradient_descent": GradientDescentLearner,
+    "matching_nets": MatchingNetsLearner,
+}
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=8,
+            image_height=14,
+            image_width=14,
+            num_classes=5,
+            per_step_bn_statistics=True,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+    )
+    defaults.update(kw)
+    return MAMLConfig(**defaults)
+
+
+def golden_fixture_episode(query: int = 3, binary: bool = False):
+    """The first recorded reference-sampler episode (5-way 1-shot), images
+    deterministically seeded from its recorded episode seed. Query rows are
+    drawn per class so the episode exercises every head index."""
+    with open(FIXTURE) as f:
+        golden = json.load(f)
+    entry = golden["configs"][0]
+    episode = entry["episodes"][0]
+    way = entry["config"]["num_classes_per_set"]
+    shot = entry["config"]["num_samples_per_class"]
+    rng = np.random.RandomState(episode["seed"])
+    shape = (1, 14, 14)
+
+    def draw(n):
+        if binary:  # omniglot-like exact-0/1 pixels (uint8 wire codec path)
+            return (rng.rand(n, *shape) > 0.5).astype(np.float32)
+        return rng.rand(n, *shape).astype(np.float32)
+
+    ys = np.asarray(episode["support_labels"], np.int32).reshape(way, shot)
+    xs = draw(way * shot).reshape(way, shot, *shape)
+    yq = np.tile(np.arange(way, dtype=np.int32)[:, None], (1, query))
+    xq = draw(way * query).reshape(way, query, *shape)
+    return xs, ys, xq, yq
+
+
+def eval_batch(xs, ys, xq, yq):
+    """(B=1, N, K, ...) episode batch for ``run_validation_iter``."""
+    return (xs[None], xq[None], ys[None], yq[None])
+
+
+def serve_and_reference(learner, state, xs, ys, xq, yq, meta_batch=3):
+    """Runs the episode through the FULL serving path (bucketing + padding:
+    one episode into a meta_batch-of-3 program), then the eval harness.
+    Returns ``(served_first, served_cache_hit, reference)`` logits."""
+    api = ServingAPI(
+        learner,
+        state,
+        ServeConfig(meta_batch_size=meta_batch, max_wait_ms=0.0),
+    )
+    try:
+        first = api.classify(xs, ys, xq)
+        again = api.classify(xs, ys, xq)
+        assert not first["cache_hit"]
+        assert again["cache_hit"], "repeat support set must hit the cache"
+    finally:
+        api.close()
+    # Reference LAST: the GD eval step donates the state buffers.
+    _, _, ref = learner.run_validation_iter(state, eval_batch(xs, ys, xq, yq))
+    return first["logits"], again["logits"], np.asarray(ref)[0]
+
+
+@pytest.mark.parametrize("family", sorted(LEARNER_CLASSES))
+def test_served_fixture_episode_bit_exact(family):
+    learner = LEARNER_CLASSES[family](tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    xs, ys, xq, yq = golden_fixture_episode()
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_maml_trained_state_bit_exact(rng):
+    """Parity must survive a real (non-init) state: one train iter first."""
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(1))
+    xs, ys, xq, yq = golden_fixture_episode()
+    train_batch = (
+        rng.randn(2, 5, 2, 1, 14, 14).astype(np.float32),
+        rng.randn(2, 5, 2, 1, 14, 14).astype(np.float32),
+        np.tile(np.arange(5)[None, :, None], (2, 1, 2)).astype(np.int32),
+        np.tile(np.arange(5)[None, :, None], (2, 1, 2)).astype(np.int32),
+    )
+    state, _ = learner.run_train_iter(state, train_batch, epoch=0)
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_maml_extra_eval_step_config_bit_exact():
+    """eval_steps == train_steps + 1 takes the eval harness's NON-final-only
+    program (prediction at the train-step index) — serving must adapt to
+    min(train, eval) steps, not the raw eval count."""
+    learner = MAMLFewShotLearner(
+        tiny_cfg(number_of_evaluation_steps_per_iter=3)
+    )
+    state = learner.init_state(jax.random.key(2))
+    xs, ys, xq, yq = golden_fixture_episode()
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_maml_uint8_wire_codec_bit_exact():
+    """The uint8 wire path (omniglot scale-1 codec, exact-0/1 pixels) must
+    stay bit-exact through serve-side encode + in-graph decode."""
+    learner = MAMLFewShotLearner(tiny_cfg(wire_codec=WireCodec(1.0, None, None)))
+    state = learner.init_state(jax.random.key(3))
+    xs, ys, xq, yq = golden_fixture_episode(binary=True)
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_matching_nets_parity_bug_mode_bit_exact():
+    """The bug-for-bug reference reproduction serves through the same split
+    (shape coincidence N*K == N*T == num_classes required by that mode)."""
+    learner = MatchingNetsLearner(tiny_cfg(), parity_bug=True)
+    state = learner.init_state(jax.random.key(4))
+    xs, ys, xq, yq = golden_fixture_episode(query=1)
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+@pytest.mark.parametrize("family", sorted(LEARNER_CLASSES))
+def test_load_for_inference_serves_bit_exact(family, tmp_path):
+    """Cold start from a manifest-verified checkpoint: params+BN-only load
+    (no optimizer state) answers bitwise identically to serving the live
+    train state."""
+    learner = LEARNER_CLASSES[family](tiny_cfg())
+    state = learner.init_state(jax.random.key(5))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state, {"current_iter": 7})
+
+    template = learner.init_inference_state(jax.random.key(99))
+    loaded, exp = load_for_inference(path, template)
+    assert exp["current_iter"] == 7
+
+    xs, ys, xq, yq = golden_fixture_episode()
+    api = ServingAPI(
+        learner, loaded, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    try:
+        served = api.classify(xs, ys, xq)["logits"]
+    finally:
+        api.close()
+    _, _, ref = learner.run_validation_iter(state, eval_batch(xs, ys, xq, yq))
+    np.testing.assert_array_equal(served, np.asarray(ref)[0])

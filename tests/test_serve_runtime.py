@@ -1,0 +1,463 @@
+"""Serving runtime mechanics: the zero-recompile contract, the
+adapted-params cache, and the micro-batcher.
+
+The recompile test is the serving twin of ``tests/test_sanitizers.py``: a
+mixed-shape request stream (5w1s, 5w5s, 3w1s, varying query counts) must
+compile each serve program exactly once per SHAPE CLASS under the PR 2
+``compile_guard`` — request count must never mint compiles.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    MatchingNetsLearner,
+)
+from howtotrainyourmamlpytorch_tpu.serve import (
+    AdaptedParamsCache,
+    MicroBatcher,
+    ServeConfig,
+    ServingAPI,
+    ServingEngine,
+    support_digest,
+)
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            image_height=8,
+            image_width=8,
+            num_classes=5,
+            per_step_bn_statistics=True,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+    )
+    defaults.update(kw)
+    return MAMLConfig(**defaults)
+
+
+def make_engine(**serve_kw):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    return ServingEngine(learner, state, ServeConfig(**serve_kw))
+
+
+def episode(rng, way=5, shot=1, query=3):
+    img = (1, 8, 8)
+    xs = rng.rand(way * shot, *img).astype(np.float32)
+    ys = np.repeat(np.arange(way), shot).astype(np.int32)
+    xq = rng.rand(query, *img).astype(np.float32)
+    return xs, ys, xq
+
+
+# ---------------------------------------------------------------------------
+# Zero per-request recompiles (compile_guard-pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_shape_stream_compiles_once_per_bucket(rng, compile_guard):
+    """5w1s / 5w5s / 3w1s with varying query counts, three passes over the
+    stream: adapt compiles once per distinct support shape, classify once
+    per distinct query shape, and NOTHING recompiles on repeat traffic."""
+    engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+    stream = [
+        (5, 1, 3),
+        (5, 5, 3),
+        (3, 1, 2),
+        (5, 1, 15),
+        (5, 1, 3),  # repeat bucket, fresh data
+    ]
+    with compile_guard() as guard:
+        for _ in range(3):  # repeat passes: request count must not compile
+            for way, shot, query in stream:
+                ep = engine.prepare_episode(*episode(rng, way, shot, query))
+                engine.dispatch([ep])
+    # Distinct adapt signatures: support counts {5, 25, 3}; distinct
+    # classify signatures: query counts {3, 2, 15}.
+    guard.assert_compiles("serve_adapt_maml", exactly=3)
+    guard.assert_compiles("serve_classify_maml", exactly=3)
+    guard.assert_unique_signatures("serve_adapt_maml")
+    guard.assert_unique_signatures("serve_classify_maml")
+    # The engine's own compile table (exported at /metrics) agrees.
+    table = engine.compile_table()
+    assert sum(v for k, v in table.items() if k.startswith("adapt:")) == 3
+    assert sum(v for k, v in table.items() if k.startswith("classify:")) == 3
+    assert all(v == 1 for v in table.values()), table
+
+
+def test_traffic_level_does_not_mint_signatures(rng, compile_guard):
+    """1, 2, and 3 concurrent episodes of one bucket all ride the same
+    padded (meta_batch,) program — concurrency is not a shape."""
+    engine = make_engine(meta_batch_size=3, max_wait_ms=0.0)
+    eps = [
+        engine.prepare_episode(*episode(rng)) for _ in range(6)
+    ]
+    with compile_guard() as guard:
+        engine.dispatch(eps[:1])
+        engine.dispatch(eps[1:3])
+        engine.dispatch(eps[3:6])
+    guard.assert_compiles("serve_adapt_maml", exactly=1)
+    guard.assert_compiles("serve_classify_maml", exactly=1)
+
+
+def test_warmup_precompiles_declared_buckets(rng, compile_guard):
+    engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+    with compile_guard() as guard:
+        engine.warmup([(5, 1, 3), (5, 5, 3)])
+        before = guard.count("serve_adapt_maml")
+        assert len(engine.cache) == 0, "warmup must not occupy cache capacity"
+        ep = engine.prepare_episode(*episode(rng, 5, 5, 3))
+        engine.dispatch([ep])
+    assert before == 2
+    guard.assert_compiles("serve_adapt_maml", exactly=2)  # no new compile
+
+
+# ---------------------------------------------------------------------------
+# Adapted-params cache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_lru_eviction_and_digest():
+    cache = AdaptedParamsCache(capacity=2)
+    rng = np.random.RandomState(0)
+    keys = []
+    for seed in range(3):
+        xs, ys, _ = episode(np.random.RandomState(seed))
+        keys.append(support_digest(xs, ys, learner="maml", state_version=0))
+    assert len(set(keys)) == 3
+    cache.put(keys[0], "a")
+    cache.put(keys[1], "b")
+    assert cache.get(keys[0]) == "a"  # refreshes recency
+    cache.put(keys[2], "c")  # evicts keys[1] (LRU)
+    assert keys[1] not in cache
+    assert cache.get(keys[0]) == "a" and cache.get(keys[2]) == "c"
+    assert cache.evictions == 1
+    # digest covers dtype: same bytes, different dtype must not collide
+    xs, ys, _ = episode(rng)
+    d32 = support_digest(xs, ys, learner="maml", state_version=0)
+    d8 = support_digest(
+        xs.astype(np.uint8), ys, learner="maml", state_version=0
+    )
+    assert d32 != d8
+
+
+def test_cache_hit_skips_adapt_program(rng):
+    engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+    xs, ys, xq = episode(rng)
+    ep1 = engine.prepare_episode(xs, ys, xq)
+    engine.dispatch([ep1])
+    adapt_count = engine.metrics.adapt_latency.snapshot()["count"]
+    # Same support, different queries: adapt must not run again.
+    ep2 = engine.prepare_episode(xs, ys, rng.rand(3, 1, 8, 8).astype(np.float32))
+    engine.dispatch([ep2])
+    assert engine.metrics.adapt_latency.snapshot()["count"] == adapt_count
+    assert engine.metrics.cache_hits.value == 1
+    assert engine.metrics.cache_misses.value == 1
+
+
+def test_state_swap_invalidates_cache(rng):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    engine = ServingEngine(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    xs, ys, xq = episode(rng)
+    first = engine.dispatch([engine.prepare_episode(xs, ys, xq)])[0]
+    assert len(engine.cache) == 1
+    state2 = learner.init_state(jax.random.key(1))
+    version = engine.update_state(state2)
+    assert version == 1
+    assert len(engine.cache) == 0
+    second = engine.dispatch([engine.prepare_episode(xs, ys, xq)])[0]
+    assert engine.metrics.cache_hits.value == 0
+    assert not np.array_equal(first, second), "new weights must answer"
+
+
+def test_mn_cache_artifact_is_embeddings_not_params(rng):
+    """Matching nets cache support embeddings (KBs), not parameter trees."""
+    learner = MatchingNetsLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    engine = ServingEngine(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    ep = engine.prepare_episode(*episode(rng))
+    engine.dispatch([ep])
+    artifact = engine.cache.get(ep.digest)
+    assert set(artifact) == {"support_emb", "support_labels"}
+    assert artifact["support_emb"].shape == (5, 5)  # (S, num_classes)
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_collates_full_group_into_one_dispatch(rng):
+    engine = make_engine(meta_batch_size=3, max_wait_ms=5000.0)
+    batcher = MicroBatcher(engine)
+    try:
+        eps = [engine.prepare_episode(*episode(rng)) for _ in range(3)]
+        futures = [batcher.submit(ep) for ep in eps]
+        logits = [f.result(timeout=30) for f in futures]
+    finally:
+        batcher.close()
+    # Full group (== max_batch) flushed as ONE meta-batch dispatch well
+    # before the 5 s deadline.
+    assert engine.metrics.batches_dispatched.value == 1
+    assert engine.metrics.padded_tasks.value == 0
+    assert all(l.shape == (3, 5) for l in logits)
+
+
+def test_batcher_deadline_flushes_partial_group(rng):
+    engine = make_engine(meta_batch_size=4, max_wait_ms=10.0)
+    batcher = MicroBatcher(engine)
+    try:
+        t0 = time.perf_counter()
+        future = batcher.submit(engine.prepare_episode(*episode(rng)))
+        logits = future.result(timeout=30)
+        waited_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        batcher.close()
+    assert logits.shape == (3, 5)
+    assert waited_ms >= 10.0, "partial group must wait out the deadline"
+    assert engine.metrics.padded_tasks.value == 3  # 1 real + 3 pad tasks
+
+
+def test_batcher_groups_by_bucket(rng):
+    """Mixed-bucket concurrent traffic dispatches per bucket, never mixed."""
+    engine = make_engine(meta_batch_size=2, max_wait_ms=20.0)
+    batcher = MicroBatcher(engine)
+    try:
+        futs = []
+        for way, shot, query in [(5, 1, 3), (3, 1, 2), (5, 1, 3), (3, 1, 2)]:
+            ep = engine.prepare_episode(*episode(rng, way, shot, query))
+            futs.append((query, batcher.submit(ep)))
+        for query, fut in futs:
+            assert fut.result(timeout=30).shape == (query, 5)
+    finally:
+        batcher.close()
+    assert engine.metrics.batches_dispatched.value == 2
+    table = engine.metrics.bucket_table()
+    assert table[(5, 1, 3)]["episodes"] == 2
+    assert table[(3, 1, 2)]["episodes"] == 2
+
+
+def test_batcher_propagates_dispatch_errors(rng, monkeypatch):
+    engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+    batcher = MicroBatcher(engine)
+
+    def boom(eps):
+        raise RuntimeError("device fell over")
+
+    monkeypatch.setattr(engine, "dispatch", boom)
+    try:
+        future = batcher.submit(engine.prepare_episode(*episode(rng)))
+        with pytest.raises(RuntimeError, match="device fell over"):
+            future.result(timeout=30)
+    finally:
+        batcher.close()
+
+
+def test_batcher_close_drains_and_rejects(rng):
+    engine = make_engine(meta_batch_size=4, max_wait_ms=60_000.0)
+    batcher = MicroBatcher(engine)
+    future = batcher.submit(engine.prepare_episode(*episode(rng)))
+    batcher.close()  # must flush the pending partial group, not strand it
+    assert future.result(timeout=5).shape == (3, 5)
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.submit(engine.prepare_episode(*episode(rng)))
+
+
+def test_concurrent_submitters_all_answered(rng):
+    api = ServingAPI(
+        MAMLFewShotLearner(tiny_cfg()),
+        MAMLFewShotLearner(tiny_cfg()).init_state(jax.random.key(0)),
+        ServeConfig(meta_batch_size=4, max_wait_ms=2.0),
+    )
+    results: dict[int, np.ndarray] = {}
+    errors: list[Exception] = []
+
+    def client(i):
+        r = np.random.RandomState(i)
+        try:
+            results[i] = api.classify(*episode(r))["logits"]
+        except Exception as exc:  # pragma: no cover - failure detail
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        api.close()
+    assert not errors
+    assert len(results) == 12
+    assert all(v.shape == (3, 5) for v in results.values())
+
+
+# ---------------------------------------------------------------------------
+# Request validation
+# ---------------------------------------------------------------------------
+
+
+def test_malformed_episodes_rejected_at_the_front_door(rng):
+    engine = make_engine(meta_batch_size=2)
+    xs, ys, xq = episode(rng)
+    with pytest.raises(ValueError, match="support labels"):
+        engine.prepare_episode(xs, ys[:-1], xq)
+    with pytest.raises(ValueError, match="expects"):
+        engine.prepare_episode(
+            rng.rand(5, 1, 9, 9).astype(np.float32), ys, xq
+        )
+    with pytest.raises(ValueError, match=r"\[0, 5\)"):
+        engine.prepare_episode(xs, ys + 3, xq)
+    with pytest.raises(ValueError, match="no query"):
+        engine.prepare_episode(xs, ys, xq[:0])
+    with pytest.raises(ValueError, match="mixed buckets"):
+        engine.dispatch(
+            [
+                engine.prepare_episode(*episode(rng, 5, 1, 3)),
+                engine.prepare_episode(*episode(rng, 5, 1, 2)),
+            ]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening pins
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_and_gapped_support_sets_rejected(rng):
+    """Bucket identity (way, shot) must be a well-defined SHAPE class: a
+    ragged support set (uneven per-class counts) or a label gap would let
+    two different support SIZES share a bucket and crash the whole
+    co-batched dispatch group at np.stack — reject at the front door."""
+    engine = make_engine(meta_batch_size=2)
+    img = (1, 8, 8)
+    # ragged: class 0 twice, class 1 once
+    with pytest.raises(ValueError, match="class-uniform"):
+        engine.prepare_episode(
+            rng.rand(3, *img).astype(np.float32),
+            np.asarray([0, 0, 1], np.int32),
+            rng.rand(2, *img).astype(np.float32),
+        )
+    # label gap: way inferred as 3 but class 1 absent
+    with pytest.raises(ValueError, match="class-uniform"):
+        engine.prepare_episode(
+            rng.rand(2, *img).astype(np.float32),
+            np.asarray([0, 2], np.int32),
+            rng.rand(2, *img).astype(np.float32),
+        )
+    # empty support: would adapt on a mean-of-empty (NaN) loss
+    with pytest.raises(ValueError, match="no support"):
+        engine.prepare_episode(
+            rng.rand(0, *img).astype(np.float32),
+            np.asarray([], np.int32),
+            rng.rand(2, *img).astype(np.float32),
+        )
+
+
+def test_classify_timeout_raises_builtin_timeouterror(rng, monkeypatch):
+    """Future.result raises concurrent.futures.TimeoutError, which on
+    Python < 3.11 is NOT the builtin — the API must translate so embedders
+    (and the HTTP 503 branch) can catch ``TimeoutError``."""
+    from concurrent.futures import Future
+
+    learner = MAMLFewShotLearner(tiny_cfg())
+    api = ServingAPI(
+        learner,
+        learner.init_state(jax.random.key(0)),
+        ServeConfig(meta_batch_size=2, max_wait_ms=0.0),
+    )
+    try:
+        monkeypatch.setattr(
+            api.batcher, "submit", lambda ep: Future()  # never resolves
+        )
+        with pytest.raises(TimeoutError, match="deadline"):
+            api.classify(*episode(rng), timeout=0.05)
+        assert api.metrics.request_errors.value == 1
+        assert api.metrics.requests_total.value == 1  # offered, not hidden
+    finally:
+        api.close()
+
+
+def test_failed_requests_still_counted(rng):
+    learner = MAMLFewShotLearner(tiny_cfg())
+    api = ServingAPI(
+        learner,
+        learner.init_state(jax.random.key(0)),
+        ServeConfig(meta_batch_size=2, max_wait_ms=0.0),
+    )
+    try:
+        xs, ys, xq = episode(rng)
+        with pytest.raises(ValueError):
+            api.classify(xs, ys[:-1], xq)
+        assert api.metrics.requests_total.value == 1
+        assert api.metrics.request_errors.value == 1
+        assert "request_errors_total 1" in api.metrics_text()
+    finally:
+        api.close()
+
+
+def test_gd_serving_uses_the_injected_learning_rate(rng, tmp_path):
+    """The GD fine-tune lr is serve STATE, not config: (a) serving a live
+    GDState uses its injected (epoch-schedule) lr bit-exactly; (b) a
+    serving cold start recomputes that lr from the checkpoint's recorded
+    training progress instead of resetting to the epoch-0 rate."""
+    import jax.numpy as jnp
+
+    from howtotrainyourmamlpytorch_tpu.models import GradientDescentLearner
+    from howtotrainyourmamlpytorch_tpu.models.common import set_injected_lr
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = tiny_cfg(total_epochs=10, total_iter_per_epoch=4)
+    learner = GradientDescentLearner(cfg)
+    state = learner.init_state(jax.random.key(0))
+    # Simulate epoch-7 training: inject the decayed lr like run_train_iter.
+    epoch = 7
+    state = state._replace(
+        opt_state=set_injected_lr(state.opt_state, learner._epoch_lr(epoch))
+    )
+    xs, ys, xq = episode(rng)
+    istate = learner.inference_state(state)
+    np.testing.assert_allclose(
+        float(istate.fine_tune_lr), learner._epoch_lr(epoch), rtol=1e-6
+    )
+
+    engine = ServingEngine(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    served = engine.dispatch([engine.prepare_episode(xs, ys, xq)])[0]
+    # Reference LAST (the GD eval step donates state buffers).
+    _, _, ref = learner.run_validation_iter(
+        state,
+        (xs.reshape(1, 5, 1, 1, 8, 8), xq.reshape(1, 3, 1, 1, 8, 8),
+         ys.reshape(1, 5, 1), np.zeros((1, 3, 1), np.int32)),
+    )
+    np.testing.assert_array_equal(served, np.asarray(ref)[0])
+
+    # Cold start: current_iter 30 at 4 iters/epoch -> epoch 7 schedule lr.
+    fresh = GradientDescentLearner(cfg)
+    full = fresh.init_state(jax.random.key(0))
+    path = str(tmp_path / "gd_ckpt")
+    save_checkpoint(path, full, {"current_iter": 30})
+    loaded, exp = fresh.load_inference_state(path)
+    assert exp["current_iter"] == 30
+    np.testing.assert_allclose(
+        float(loaded.fine_tune_lr), fresh._epoch_lr(7), rtol=1e-6
+    )
+    assert isinstance(loaded.fine_tune_lr, jnp.ndarray)

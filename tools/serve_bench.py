@@ -1,0 +1,182 @@
+"""Serving-path benchmark: adapt+classify throughput and latency keys.
+
+Measures the in-process serving runtime (``ServingAPI`` — engine + batcher
++ cache; HTTP excluded by design so the keys track DEVICE-path regressions,
+not json parsing) at the flagship Omniglot shapes, and prints ONE JSON line
+with the PERF_NOTES.md "Serving path" keys:
+
+* ``serve_qps``            — cold-support episodes/s through the batched
+                             adapt+classify pipeline (every episode pays
+                             the inner loop), offered concurrently so
+                             micro-batching engages;
+* ``serve_adapt_p50_ms`` / ``serve_adapt_p99_ms`` — adapt dispatch latency
+                             quantiles over the run (per meta-batch);
+* ``serve_classify_p50_ms``                       — same for classify;
+* ``serve_cache_hit_qps``  — episodes/s when every support set is already
+                             cached (the adapted-params cache's best case:
+                             classify-only);
+* ``serve_compiles``       — compile-table size + total traces at exit
+                             (the zero-per-request-recompile receipt).
+
+Usage: ``python tools/serve_bench.py [--tiny] [--budget-s 5]``
+(``--tiny`` runs a 2-stage 14x14 net — CI-sized; default is the flagship
+64-filter 28x28 Omniglot config on the current backend, quiet-chip protocol
+per PERF_NOTES.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_api(tiny: bool, max_batch: int, max_wait_ms: float, cache: int):
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.models import (
+        BackboneConfig,
+        MAMLConfig,
+        MAMLFewShotLearner,
+    )
+    from howtotrainyourmamlpytorch_tpu.serve import ServeConfig, ServingAPI
+
+    if tiny:
+        cfg = MAMLConfig(
+            backbone=BackboneConfig(
+                num_stages=2, num_filters=8, image_height=14, image_width=14,
+                num_classes=5, per_step_bn_statistics=True, num_steps=2,
+            ),
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+        )
+    else:
+        # Flagship bundled run's shapes (bench.py): Omniglot 5-way, 64
+        # filters, 5 inner steps, per-step BN.
+        cfg = MAMLConfig(
+            backbone=BackboneConfig(
+                num_stages=4, num_filters=64, image_height=28, image_width=28,
+                num_classes=5, per_step_bn_statistics=True, num_steps=5,
+            ),
+            number_of_training_steps_per_iter=5,
+            number_of_evaluation_steps_per_iter=5,
+        )
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_inference_state(jax.random.PRNGKey(0))
+    return ServingAPI(
+        learner,
+        state,
+        ServeConfig(
+            meta_batch_size=max_batch,
+            max_wait_ms=max_wait_ms,
+            cache_capacity=cache,
+        ),
+    )
+
+
+def episode_pool(api, n: int, shot: int = 1, query: int = 15, seed: int = 0):
+    """``n`` distinct synthetic episodes at the served way/shot/query."""
+    bb = api.engine.learner.cfg.backbone
+    rng = np.random.RandomState(seed)
+    way = bb.num_classes
+    img = (bb.image_channels, bb.image_height, bb.image_width)
+    pool = []
+    for _ in range(n):
+        xs = rng.rand(way * shot, *img).astype(np.float32)
+        ys = np.repeat(np.arange(way), shot).astype(np.int32)
+        xq = rng.rand(query, *img).astype(np.float32)
+        pool.append((xs, ys, xq))
+    return pool
+
+
+def offered_qps(api, episodes, budget_s: float, threads: int) -> float:
+    """Episodes/s with ``threads`` concurrent clients cycling ``episodes``."""
+    stop_at = time.perf_counter() + budget_s
+    counts = [0] * threads
+
+    def client(tid: int) -> None:
+        i = tid
+        while time.perf_counter() < stop_at:
+            xs, ys, xq = episodes[i % len(episodes)]
+            api.classify(xs, ys, xq)
+            counts[tid] += 1
+            i += threads
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(threads) as pool:
+        list(pool.map(client, range(threads)))
+    return sum(counts) / (time.perf_counter() - t0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tiny", action="store_true",
+                        help="CI-sized model instead of the flagship shapes")
+    parser.add_argument("--budget-s", type=float, default=5.0)
+    parser.add_argument("--max-batch", type=int, default=4)
+    parser.add_argument("--threads", type=int, default=8)
+    parser.add_argument("--shot", type=int, default=1)
+    parser.add_argument("--query", type=int, default=15)
+    opts = parser.parse_args(argv)
+
+    import jax
+
+    api = build_api(opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512)
+    way = api.engine.learner.cfg.backbone.num_classes
+    api.engine.warmup([(way, opts.shot, opts.query)])
+
+    # Cold path: every episode must pay the inner loop. The pool cycles, so
+    # the cache is disabled for this phase (capacity 0 = no store) — a long
+    # budget would otherwise wrap the pool and silently measure hits.
+    cold_pool = episode_pool(api, n=64, shot=opts.shot, query=opts.query)
+    api.engine.cache.clear()
+    api.engine.cache.capacity = 0
+    serve_qps = offered_qps(api, cold_pool, opts.budget_s, opts.threads)
+    api.engine.cache.capacity = 512
+    adapt = api.metrics.adapt_latency.snapshot()
+    classify = api.metrics.classify_latency.snapshot()
+
+    # Hot path: one episode repeated — every request hits the cache.
+    hot_pool = episode_pool(api, n=1, shot=opts.shot, query=opts.query, seed=7)
+    xs, ys, xq = hot_pool[0]
+    api.classify(xs, ys, xq)  # prime the cache entry
+    cache_hit_qps = offered_qps(api, hot_pool, opts.budget_s, opts.threads)
+
+    compile_table = api.engine.compile_table()
+    result = {
+        "metric": "serve_qps",
+        "value": round(serve_qps, 3),
+        "unit": "episodes/s",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "tiny": bool(opts.tiny),
+        "meta_batch_size": opts.max_batch,
+        "threads": opts.threads,
+        "bucket": f"{way}x{opts.shot}x{opts.query}",
+        "serve_qps": round(serve_qps, 3),
+        "serve_cache_hit_qps": round(cache_hit_qps, 3),
+        "serve_adapt_p50_ms": round(adapt["p50_ms"], 3),
+        "serve_adapt_p99_ms": round(adapt["p99_ms"], 3),
+        "serve_classify_p50_ms": round(classify["p50_ms"], 3),
+        "serve_cache_hit_rate_final": round(
+            api.metrics.cache_hit_rate(), 4
+        ),
+        "serve_compiles": {
+            "programs": len(compile_table),
+            "total_traces": sum(compile_table.values()),
+        },
+    }
+    print(json.dumps(result))
+    api.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
