@@ -478,6 +478,21 @@ def main() -> None:
 
     real = _measure_real_data()
     real_per_iter, real_k25 = real if real is not None else (None, None)
+
+    # Telemetry overhead on the K=1 train path (telemetry/ subsystem: per-
+    # dispatch step events + forced-read boundary flushes). Median of
+    # paired windows; protocol in tools/telemetry_report.py and
+    # PERF_NOTES.md "Telemetry overhead".
+    try:
+        from tools.telemetry_report import measure_overhead
+
+        telemetry_overhead_pct = measure_overhead(
+            tiny=False, budget_s=6.0, windows=3
+        )["value"]
+    except Exception as exc:  # noqa: BLE001 — observability extra only
+        print(f"# telemetry overhead unavailable: {exc}", file=sys.stderr)
+        telemetry_overhead_pct = None
+
     sentinel_after_ms = _sentinel_ms()
     # Sampled before AND after: a trainer that was host-side during the
     # bench but exits before the end (or starts mid-run) must still flag.
@@ -547,6 +562,9 @@ def main() -> None:
                 "imagenet_shape_fused_train_pool_meta_iters_per_s": round(
                     im_fused_pool_value, 2
                 ),
+                # Telemetry subsystem cost on the K=1 path (median paired
+                # delta; ~0 within noise — PERF_NOTES.md).
+                "telemetry_overhead_pct": telemetry_overhead_pct,
                 # Contention sentinel (VERDICT r2 weak #1): a fixed tiny
                 # program timed before/after; poisoned numbers self-label.
                 "sentinel_before_ms": round(sentinel_before_ms, 2),

@@ -26,6 +26,7 @@ import concurrent.futures.process
 import multiprocessing
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -88,6 +89,12 @@ class MetaLearningSystemDataLoader:
         self.batches_per_iter = args.samples_per_iter
         self.full_data_length = dict(self.dataset.data_length)
         self.continue_from_iter(current_iter=current_iter)
+        # Telemetry: host seconds the CONSUMER spent blocked on the
+        # prefetch queue since the last pop_data_wait() — the "data wait"
+        # half of the step-time breakdown (an empty queue means episode
+        # synthesis, not the device, is the bottleneck). Accrued in the
+        # consumer thread itself, so no locking is needed.
+        self._data_wait_s = 0.0
         # Synthesis backend: "thread" (default — PIL/NumPy/native-C release
         # the GIL, zero IPC) or "process" (the reference's DataLoader-worker
         # model, data.py:580 — forked workers sidestep the GIL entirely and
@@ -130,6 +137,15 @@ class MetaLearningSystemDataLoader:
         """Fast-forwards the train seed offset after resume (``data.py:
         583-588``)."""
         self.total_train_iters_produced += current_iter * self.global_batch
+
+    def pop_data_wait(self) -> float:
+        """Returns and resets the seconds the consumer has spent blocked on
+        batch delivery since the previous call. Sampled by the trainer once
+        per dispatch: ``step_time - data_wait`` is then the device-dispatch
+        share, making a slow loader distinguishable from a slow device in
+        the epoch CSV and ``logs/telemetry.jsonl``."""
+        waited, self._data_wait_s = self._data_wait_s, 0.0
+        return waited
 
     # ------------------------------------------------------------------
     # Batch generation
@@ -227,7 +243,9 @@ class MetaLearningSystemDataLoader:
         thread = threading.Thread(target=produce, daemon=True)
         thread.start()
         while True:
+            t_blocked = time.perf_counter()
             batch = out.get()
+            self._data_wait_s += time.perf_counter() - t_blocked
             if batch is sentinel:
                 break
             if isinstance(batch, _ProducerError):

@@ -34,6 +34,7 @@ import time
 import jax
 import numpy as np
 
+from .telemetry import TrainTelemetry
 from .utils import faultinject
 from .utils.checkpoint import CheckpointCorruptError, publish_alias
 from .utils.storage import (
@@ -172,17 +173,28 @@ class ExperimentBuilder:
             self.model, "run_train_iters"
         )
         # Observability (SURVEY §5 tracing row — the reference has none):
-        # dispatch-to-dispatch wall times summarized into per-epoch
-        # percentiles, plus an optional jax.profiler trace of the first
-        # profile_num_iters train iterations of this run.
-        self._step_times: list[float] = []
-        self._last_dispatch_t: float | None = None
-        self.profile_trace_path = str(
-            getattr(args, "profile_trace_path", "") or ""
+        # the unified telemetry subsystem (telemetry/). Structured run
+        # events in logs/telemetry.jsonl (per-dispatch step-time breakdown
+        # split into data-wait vs device dispatch, XLA compile events,
+        # checkpoint durations, sentinel/preemption events — buffered on
+        # the host, flushed only at forced-read boundaries, so the hot
+        # path gains zero new syncs), per-epoch step-time percentiles for
+        # the summary CSV, and on-demand bounded jax.profiler captures
+        # (file trigger / SIGUSR1, generalizing the first-N-iters-only
+        # --profile_trace_path hook).
+        self.telemetry = TrainTelemetry(
+            self.logs_filepath,
+            enabled=bool(getattr(args, "telemetry", True)),
+            profile_trace_path=str(
+                getattr(args, "profile_trace_path", "") or ""
+            ),
+            profile_num_iters=int(
+                getattr(args, "profile_num_iters", 20) or 20
+            ),
+            profile_trigger_path=str(
+                getattr(args, "profile_trigger_path", "") or ""
+            ),
         )
-        self.profile_num_iters = int(getattr(args, "profile_num_iters", 20) or 20)
-        self._profiling = False
-        self._profiled_iters = 0
 
     # ------------------------------------------------------------------
     # Metric summarization (experiment_builder.py:65-100)
@@ -375,6 +387,10 @@ class ExperimentBuilder:
         the phase is stateless and simply re-runs on requeue."""
         if self._shutdown_signum is None:
             return
+        self.telemetry.event(
+            "preemption", signal=int(self._shutdown_signum),
+            iter=int(self.state["current_iter"]),
+        )
         if not write_checkpoint:
             self._write_interruption_row()
             print(
@@ -383,6 +399,12 @@ class ExperimentBuilder:
                 "re-runs in full on resume)",
                 flush=True,
             )
+            self.telemetry.event("requeue_exit", code=REQUEUE_EXIT_CODE)
+            # Belt alongside run_experiment's finally: the profiler trace
+            # and the event buffer flush BEFORE the process commits to
+            # exiting (a SIGTERM inside a capture window must not leave
+            # the trace unflushed).
+            self.telemetry.shutdown()
             sys.exit(REQUEUE_EXIT_CODE)
         # The emergency write must honor the sentinel contract: a NaN that
         # tripped since the last log-cadence check would otherwise be
@@ -417,6 +439,11 @@ class ExperimentBuilder:
             + f"; exiting with requeue code {REQUEUE_EXIT_CODE}",
             flush=True,
         )
+        self.telemetry.event(
+            "requeue_exit", code=REQUEUE_EXIT_CODE,
+            emergency_checkpoint=not bool(trips),
+        )
+        self.telemetry.shutdown()  # flush trace + events before the exit
         sys.exit(REQUEUE_EXIT_CODE)
 
     def _sentinel_check(self, losses, current_iter: int) -> None:
@@ -435,6 +462,10 @@ class ExperimentBuilder:
         )
         if trips == 0.0:
             return
+        self.telemetry.event(
+            "nonfinite_trip", iter=int(current_iter), trips=trips,
+            policy=self.on_nonfinite, scope="dispatch",
+        )
         if self.on_nonfinite == "halt":
             raise NonFiniteLossError(
                 f"non-finite meta-loss detected at iteration {current_iter} "
@@ -460,6 +491,10 @@ class ExperimentBuilder:
         )
         if trips == 0.0:
             return
+        self.telemetry.event(
+            "nonfinite_trip", iter=int(self.state["current_iter"]),
+            trips=trips, policy=self.on_nonfinite, scope="epoch",
+        )
         if self.on_nonfinite == "halt":
             raise NonFiniteLossError(
                 f"{int(trips)} non-finite loss(es) in the epoch ending "
@@ -523,65 +558,26 @@ class ExperimentBuilder:
         )
         self.epoch = restored_iter // int(self.args.total_iter_per_epoch)
         self.total_losses = {}
-        self._step_times = []
-        self._last_dispatch_t = None
+        self.telemetry.event(
+            "rollback", trip_iter=trip_iter, restored_iter=restored_iter,
+            trips=trips, rollbacks_this_run=self._rollbacks_this_run,
+        )
+        self.telemetry.reset_window()
 
     # ------------------------------------------------------------------
-    # Observability
+    # Observability (delegated to telemetry/ — see TrainTelemetry)
     # ------------------------------------------------------------------
 
-    def _record_dispatch(self, n_iters: int = 1) -> None:
-        """Dispatch-to-dispatch wall time per iteration (the practical
-        steady-state step time; metrics stay lazy so no device sync)."""
-        now = time.perf_counter()
-        if self._last_dispatch_t is not None:
-            self._step_times.extend(
-                [(now - self._last_dispatch_t) / n_iters] * n_iters
-            )
-        self._last_dispatch_t = now
-        self._profile_tick(n_iters)
-
-    def _profile_tick(self, n_iters: int) -> None:
-        if not self.profile_trace_path:
-            return
-        if not self._profiling and self._profiled_iters == 0:
-            jax.profiler.start_trace(self.profile_trace_path)
-            self._profiling = True
-            print("profiler trace started ->", self.profile_trace_path)
-        if self._profiling:
-            self._profiled_iters += n_iters
-            if self._profiled_iters >= self.profile_num_iters:
-                self._stop_profiler()
-
-    def _stop_profiler(self) -> None:
-        """Idempotent; also called from run_experiment's finally so a short
-        or crashing run still flushes the trace file."""
-        if self._profiling:
-            jax.profiler.stop_trace()
-            self._profiling = False
-            self.profile_trace_path = ""  # one-shot
-            print("profiler trace stopped")
-
-    def _epoch_step_time_stats(self) -> dict:
-        # Always drop the anchor at epoch end: the next epoch's first
-        # dispatch must not measure the val-epoch + checkpoint gap.
-        self._last_dispatch_t = None
-        if not self._step_times:
-            # STABLE SCHEMA: emit the keys as NaN rather than omitting them.
-            # An epoch with <2 dispatches (a mid-epoch emergency resume, or
-            # K >= total_iter_per_epoch) otherwise writes a CSV row two
-            # columns short of the header and silently misaligns every
-            # column after "epoch" (rows are positional).
-            return {
-                "train_step_time_p50": float("nan"),
-                "train_step_time_p95": float("nan"),
-            }
-        times = np.asarray(self._step_times)
-        self._step_times = []
-        return {
-            "train_step_time_p50": float(np.percentile(times, 50)),
-            "train_step_time_p95": float(np.percentile(times, 95)),
-        }
+    def _record_dispatch(self, n_iters: int = 1, upto_iter: int = 0) -> None:
+        """One completed device dispatch ending at ``upto_iter``: samples
+        the loader's blocked-in-``next`` time (the data-wait share of the
+        step) and hands both to the telemetry recorder. Metrics stay lazy —
+        no device sync."""
+        pop_wait = getattr(self.data, "pop_data_wait", None)
+        data_wait_s = float(pop_wait()) if pop_wait is not None else 0.0
+        self.telemetry.record_dispatch(
+            upto_iter, n_iters=n_iters, data_wait_s=data_wait_s
+        )
 
     # ------------------------------------------------------------------
     # Iterations (experiment_builder.py:102-188)
@@ -598,7 +594,7 @@ class ExperimentBuilder:
         self.train_state, losses = self.model.run_train_iter(
             self.train_state, data_batch, epoch=epoch_idx
         )
-        self._record_dispatch()
+        self._record_dispatch(upto_iter=current_iter + 1)
         # Metrics are device scalars; they are appended UNREAD so the host
         # never blocks on the step it just dispatched (the summary forces
         # them at epoch boundaries). Reading per-iteration here measured an
@@ -609,13 +605,19 @@ class ExperimentBuilder:
         current_iter += 1
         if current_iter % TRAIN_LOG_EVERY == 0 or current_iter == 1:
             # Both the print and the sentinel force the same already-computed
-            # device scalars — one sync, shared.
+            # device scalars — one sync, shared. The forced read is timed as
+            # the host-sync share of the step breakdown, and the telemetry
+            # buffer flushes HERE (its only hot-loop I/O point).
+            t_sync = time.perf_counter()
             self._sentinel_check(losses, current_iter)
+            summary = self.build_loss_summary_string(losses)
+            sync_s = time.perf_counter() - t_sync
             print(
                 f"training iter {current_iter} epoch {self.epoch} -> "
-                + self.build_loss_summary_string(losses),
+                + summary,
                 flush=True,
             )
+            self.telemetry.boundary(current_iter, sync_s, reason="log")
         return total_losses, current_iter
 
     def train_iteration_multi(self, samples, epoch_idx, total_losses, current_iter):
@@ -626,17 +628,23 @@ class ExperimentBuilder:
         self.train_state, losses = self.model.run_train_iters(
             self.train_state, batches, epoch=epoch_idx
         )
-        self._record_dispatch(len(samples))
+        self._record_dispatch(
+            len(samples), upto_iter=current_iter + len(samples)
+        )
         for key, value in losses.items():
             total_losses.setdefault(key, []).append(value)
         current_iter += len(samples)
         if _multi_log_due(current_iter, len(samples)):
+            t_sync = time.perf_counter()
             self._sentinel_check(losses, current_iter)
+            summary = self.build_loss_summary_string(losses)
+            sync_s = time.perf_counter() - t_sync
             print(
                 f"training iter {current_iter} epoch {self.epoch} -> "
-                + self.build_loss_summary_string(losses),
+                + summary,
                 flush=True,
             )
+            self.telemetry.boundary(current_iter, sync_s, reason="log")
         return total_losses, current_iter
 
     def evaluation_iteration(self, val_sample, total_losses, phase):
@@ -782,9 +790,16 @@ class ExperimentBuilder:
     def run_experiment(self):
         self._install_signal_handlers()
         try:
-            return self._run_experiment()
+            # activate(): installs the process-global event sink (so
+            # checkpoint saves/loads and serve dispatches self-report), the
+            # XLA compile-event bridge, and the SIGUSR1 profile trigger;
+            # its finally stops any in-flight profiler capture and flushes
+            # the event buffer on EVERY exit path (return, clean pause,
+            # preemption-requeue, crash).
+            with self.telemetry.activate():
+                return self._run_experiment()
         finally:
-            self._stop_profiler()
+            self.telemetry.shutdown()
             self._restore_signal_handlers()
 
     def _run_experiment(self):
@@ -845,10 +860,21 @@ class ExperimentBuilder:
                 )
 
             if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
+                # The epoch summary is the big forced read of the loop
+                # (every accumulated device scalar); its wall time is the
+                # epoch-boundary host-sync sample of the step breakdown.
+                t_sync = time.perf_counter()
                 train_losses = self.build_summary_dict(
                     self.total_losses, phase="train"
                 )
-                train_losses.update(self._epoch_step_time_stats())
+                epoch_sync_s = time.perf_counter() - t_sync
+                train_losses.update(
+                    self.telemetry.epoch_stats("train", epoch=self.epoch)
+                )
+                self.telemetry.boundary(
+                    self.state["current_iter"], epoch_sync_s,
+                    reason="epoch_summary",
+                )
                 # Epoch-boundary sentinel: runs BEFORE validation and
                 # checkpointing, so a poisoned epoch can neither waste a
                 # val pass (halt/rollback) nor reach a checkpoint.
@@ -903,6 +929,10 @@ class ExperimentBuilder:
                                           "summary_statistics.json"),
                     dict_to_store=self.state["per_epoch_statistics"],
                 )
+                # Flush the checkpoint-save/alias events the epoch publish
+                # just emitted (still a forced-read boundary, zero new
+                # syncs).
+                self.telemetry.flush()
                 if self.epochs_done_in_this_run >= self.total_epochs_before_pause:
                     print(
                         "train_seed {}, val_seed: {}, at pause time".format(

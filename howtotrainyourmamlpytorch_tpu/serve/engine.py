@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.common import encode_images
+from ..telemetry import events as telemetry_events
 from .cache import AdaptedParamsCache, support_digest
 from .metrics import ServeMetrics
 
@@ -127,9 +128,14 @@ class ServingEngine:
     def _note_trace(self, label: str) -> None:
         # Runs at TRACE time only (inside the jitted python body), i.e.
         # exactly once per new shape signature — the per-bucket compile
-        # table /metrics exports. Intentional trace-time side effect.
+        # table /metrics exports. Intentional trace-time side effect; the
+        # telemetry event is a buffered host append (no-op without an
+        # installed sink), never device work.
         with self._compiles_lock:
             self._compiles[label] = self._compiles.get(label, 0) + 1
+        telemetry_events.emit(
+            "serve_compile", program=label, family=self.family
+        )
 
     def _build_programs(self):
         learner = self.learner
@@ -290,6 +296,7 @@ class ServingEngine:
         self.metrics.record_bucket_dispatch(eps[0].bucket, len(eps))
 
         # --- adapt (cache misses only) ---------------------------------
+        adapt_ms: float | None = None
         artifacts: list[Tree | None] = [None] * len(eps)
         miss: list[int] = []
         for i, ep in enumerate(eps):
@@ -306,9 +313,8 @@ class ServingEngine:
             t0 = time.perf_counter()
             adapted = self._adapt(istate, xs, ys)
             adapted = jax.block_until_ready(adapted)
-            self.metrics.adapt_latency.observe(
-                (time.perf_counter() - t0) * 1e3
-            )
+            adapt_ms = (time.perf_counter() - t0) * 1e3
+            self.metrics.adapt_latency.observe(adapt_ms)
             for row, i in enumerate(miss):
                 artifact = jax.tree.map(lambda a: a[row], adapted)
                 artifacts[i] = artifact
@@ -324,9 +330,18 @@ class ServingEngine:
         t0 = time.perf_counter()
         logits = self._classify(istate, stacked, xq)
         logits = jax.block_until_ready(logits)
-        self.metrics.classify_latency.observe((time.perf_counter() - t0) * 1e3)
+        classify_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.classify_latency.observe(classify_ms)
         host = np.asarray(logits)
         self.metrics.episodes_served.inc(len(eps))
+        telemetry_events.emit(
+            "serve_dispatch",
+            bucket="x".join(str(d) for d in eps[0].bucket),
+            episodes=len(eps),
+            cache_hits=len(eps) - len(miss),
+            adapt_ms=adapt_ms,
+            classify_ms=classify_ms,
+        )
         return [host[i] for i in range(len(eps))]
 
     # ------------------------------------------------------------------

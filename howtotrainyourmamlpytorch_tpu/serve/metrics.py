@@ -1,12 +1,13 @@
 """Serving observability: latency quantiles, counters, Prometheus text.
 
-Small and dependency-free by design (the container bakes no metrics client).
-Latency percentiles are computed EXACTLY over a bounded ring of recent
-samples rather than approximated from fixed histogram buckets — at serving
-rates the ring covers minutes of traffic, and the bench keys
-(``serve_adapt_p50_ms``; PERF_NOTES.md "Serving path") need real medians,
-not bucket midpoints. Cumulative ``count``/``sum`` still cover the full
-process lifetime, so rate math over scrapes stays correct.
+The metric primitives (``Counter`` and ``LatencyStat`` — the exact-window
+quantile stat) live in the shared telemetry subsystem
+(``telemetry/registry.py``) and are re-exported here, so the serving
+runtime and the trainer run ONE implementation. The Prometheus text this
+module renders is byte-identical to the pre-factoring surface
+(``tests/test_serve_http.py`` scrapes it unchanged); the quantile/window
+rationale (exact medians, not bucket midpoints) is documented with the
+primitives.
 
 Everything here is thread-safe: the HTTP frontend scrapes ``/metrics`` from
 its own threads while batcher/engine threads record.
@@ -15,60 +16,10 @@ its own threads while batcher/engine threads record.
 from __future__ import annotations
 
 import threading
-from collections import deque
 
+from ..telemetry.registry import Counter, LatencyStat
 
-class LatencyStat:
-    """Cumulative count/sum plus exact percentiles over a recent window."""
-
-    def __init__(self, name: str, window: int = 2048):
-        self.name = name
-        self._lock = threading.Lock()
-        self._recent: deque[float] = deque(maxlen=window)
-        self._count = 0
-        self._sum = 0.0
-
-    def observe(self, value_ms: float) -> None:
-        with self._lock:
-            self._recent.append(float(value_ms))
-            self._count += 1
-            self._sum += float(value_ms)
-
-    def percentile(self, p: float) -> float:
-        """Exact percentile (nearest-rank) of the recent window; 0.0 when
-        empty."""
-        with self._lock:
-            if not self._recent:
-                return 0.0
-            ordered = sorted(self._recent)
-        rank = min(len(ordered) - 1, max(0, round(p / 100.0 * (len(ordered) - 1))))
-        return ordered[rank]
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            count, total = self._count, self._sum
-        return {
-            "count": count,
-            "sum_ms": total,
-            "p50_ms": self.percentile(50),
-            "p99_ms": self.percentile(99),
-        }
-
-
-class Counter:
-    def __init__(self, name: str):
-        self.name = name
-        self._lock = threading.Lock()
-        self._value = 0
-
-    def inc(self, by: int = 1) -> None:
-        with self._lock:
-            self._value += by
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._value
+__all__ = ["Counter", "LatencyStat", "ServeMetrics"]
 
 
 class ServeMetrics:

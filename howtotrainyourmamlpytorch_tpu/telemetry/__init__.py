@@ -1,0 +1,40 @@
+"""Unified telemetry subsystem: shared metrics, structured events, profiling.
+
+One observability layer for BOTH runtimes (ROADMAP: "as fast as the
+hardware allows" + "serves heavy traffic" are claims that need receipts):
+
+* ``registry`` — counters / gauges / exact-window quantile stats. The
+  serving frontend's ``serve/metrics.py`` re-exports these (its Prometheus
+  surface is byte-identical to the pre-factoring implementation) and the
+  trainer keeps its step-time distributions in a ``MetricsRegistry``.
+* ``events``  — host-buffered structured JSONL run log
+  (``logs/telemetry.jsonl``): per-dispatch step-time breakdown (data-wait
+  vs device vs host-sync), XLA compile events, checkpoint save/load
+  durations, divergence-sentinel trips, preemption/requeue/rollback —
+  flushed only at forced-read boundaries, so the train hot path gains zero
+  new host syncs and zero recompiles (pinned under ``compile_guard``).
+* ``profiling`` — on-demand bounded ``jax.profiler`` captures mid-run via
+  file trigger or ``SIGUSR1``, generalizing the first-N-iters-only flag.
+* ``runtime`` — ``TrainTelemetry``, the builder-facing composition root.
+
+Reporting: ``tools/telemetry_report.py`` renders a run's JSONL into a
+step-time breakdown table, compile timeline and event log, and measures
+the ``telemetry_overhead_pct`` bench key (PERF_NOTES.md protocol).
+"""
+
+from .events import SCHEMA_VERSION, EventLog, read_events
+from .profiling import ProfilerController
+from .registry import Counter, Gauge, LatencyStat, MetricsRegistry
+from .runtime import TrainTelemetry
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EventLog",
+    "read_events",
+    "ProfilerController",
+    "Counter",
+    "Gauge",
+    "LatencyStat",
+    "MetricsRegistry",
+    "TrainTelemetry",
+]

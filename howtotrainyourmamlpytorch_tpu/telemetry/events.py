@@ -1,0 +1,195 @@
+"""Structured run-event log: host-buffered JSONL, flushed at boundaries.
+
+One line per event, ``{"t": <unix seconds>, "type": <str>, ...payload}``.
+The contract that keeps this safe on the train hot path:
+
+* ``emit`` only ever APPENDS a dict to an in-memory buffer — no I/O, no
+  device reads. Payload fields must already be host scalars/strings;
+  callers never pass device arrays (that would smuggle a host sync into
+  the dispatch loop).
+* ``flush`` performs the file append, and is only called from points that
+  already force a device read (the ``TRAIN_LOG_EVERY`` cadence, epoch
+  boundaries, shutdown paths) — so telemetry adds zero new syncs and zero
+  hot-path I/O.
+
+A process-global sink (``install``/``emit``) lets deep layers publish
+events without threading a logger through every signature —
+``utils/checkpoint.py`` times save/load, ``serve/engine.py`` notes
+dispatches and compiles. Exactly like ``utils/faultinject.py``, the hooks
+are one ``None``-check when nothing is installed, so library code pays
+nothing outside an instrumented run.
+
+Non-finite floats are serialized as ``null`` (strict JSON; ``NaN`` literals
+would break non-Python consumers of the JSONL).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import threading
+import time
+
+#: Bump when the event-line layout changes incompatibly
+#: (``tools/telemetry_report.py`` refuses newer schemas).
+SCHEMA_VERSION = 1
+
+
+def _jsonable(value):
+    """Host-side coercion, recursive through dict/list/tuple payloads:
+    numpy scalars -> python, non-finite -> None (a NaN deep inside an
+    epoch-summary snapshot must degrade to null, not raise at flush time
+    and kill the run). Values must already live on the host — this never
+    forces a device read."""
+    if isinstance(value, dict):
+        return {key: _jsonable(item) for key, item in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(item) for item in value]
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
+        out = value.item()
+        if isinstance(out, float) and not math.isfinite(out):
+            return None
+        return out
+    return value
+
+
+class EventLog:
+    """Append-only buffered JSONL event log for one run."""
+
+    def __init__(self, path: str, clock=time.time):
+        self.path = path
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buffer: list[dict] = []
+        self._wrote_header = False
+        self._flush_failures = 0
+        self._serialize_failures = 0
+
+    def emit(self, event_type: str, **fields) -> None:
+        """Buffers one event. No I/O — see the module contract."""
+        record = {"t": self._clock(), "type": str(event_type)}
+        for key, value in fields.items():
+            record[key] = _jsonable(value)
+        with self._lock:
+            self._buffer.append(record)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+    def flush(self) -> int:
+        """Appends every buffered event to ``path``; returns the number of
+        lines written. Only call from forced-read boundaries.
+
+        Telemetry is an observability EXTRA: an I/O failure here (disk
+        full, NFS blip) degrades to a dropped batch and a stderr warning —
+        it must never crash a training run the fault-tolerance runtime was
+        built to keep alive, and never turn a clean preemption-requeue
+        exit (code 75) into a crash."""
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+            if not batch:
+                return 0
+            header_due = not self._wrote_header
+            self._wrote_header = True
+        lines = []
+        if header_due:
+            lines.append(
+                json.dumps(
+                    {"t": self._clock(), "type": "schema",
+                     "version": SCHEMA_VERSION}
+                )
+            )
+        dropped = 0
+        for record in batch:
+            try:
+                lines.append(json.dumps(record, allow_nan=False))
+            except (TypeError, ValueError):
+                # A caller slipped a non-JSON payload (ndarray, set, ...)
+                # past _jsonable: drop THAT record, keep the rest — the
+                # never-crash contract covers serialization too.
+                dropped += 1
+        if dropped:
+            with self._lock:
+                self._serialize_failures += dropped
+                first = self._serialize_failures == dropped
+            if first:
+                print(
+                    f"WARNING: dropped {dropped} telemetry event(s) with "
+                    "non-JSON payloads (telemetry degrades, training "
+                    "continues)",
+                    file=sys.stderr,
+                )
+        try:
+            with open(self.path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError as exc:
+            with self._lock:
+                self._flush_failures += 1
+                first = self._flush_failures == 1
+                if header_due:
+                    self._wrote_header = False  # header never reached disk
+            if first:  # warn once, not once per boundary
+                print(
+                    f"WARNING: telemetry flush to {self.path} failed "
+                    f"({exc}); dropping {len(batch)} buffered event(s) — "
+                    "training continues, telemetry degrades",
+                    file=sys.stderr,
+                )
+            return 0
+        return len(lines)
+
+    def close(self) -> None:
+        self.flush()
+
+
+def read_events(path: str) -> list[dict]:
+    """Parses a telemetry JSONL file back into event dicts (blank lines
+    skipped). Raises ``ValueError`` on a schema line newer than this
+    build understands — refuse to misread rather than silently drop."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            record = json.loads(line)
+            if record.get("type") == "schema":
+                version = int(record.get("version", -1))
+                if version > SCHEMA_VERSION:
+                    raise ValueError(
+                        f"{path}: telemetry schema {version} is newer than "
+                        f"this build reads (up to {SCHEMA_VERSION})"
+                    )
+            events.append(record)
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Process-global sink
+# ---------------------------------------------------------------------------
+
+_active: EventLog | None = None
+
+
+def install(log: EventLog | None) -> EventLog | None:
+    """Makes ``log`` the process-global sink; returns the previous one so
+    callers can restore it (nesting-safe)."""
+    global _active
+    previous = _active
+    _active = log
+    return previous
+
+
+def active() -> EventLog | None:
+    return _active
+
+
+def emit(event_type: str, **fields) -> None:
+    """Publishes to the installed sink; a single ``None``-check no-op
+    otherwise (the production path pays nothing)."""
+    if _active is not None:
+        _active.emit(event_type, **fields)

@@ -1,0 +1,134 @@
+"""On-demand bounded ``jax.profiler`` captures for a live training run.
+
+Generalizes the original first-N-iters-only hook (``--profile_trace_path``
+traced iterations 1..N of the run, and nothing else, ever): the controller
+still supports that start-of-run one-shot, and additionally arms a bounded
+capture MID-RUN from two triggers —
+
+* **file**: touch the trigger file (default
+  ``<experiment>/logs/profile_trigger``); it is consumed (deleted) and the
+  next ``num_iters`` train iterations are traced. Polled only at the
+  ``TRAIN_LOG_EVERY`` forced-read boundaries, so the hot path never pays a
+  ``stat()``.
+* **signal**: ``SIGUSR1`` (installed by ``TrainTelemetry.activate`` on the
+  main thread). The handler only flips a flag — async-signal-safe — and the
+  next dispatch boundary starts the capture.
+
+Each triggered capture writes to its own ``on_demand_<n>`` subdirectory, so
+repeated triggers over a long run never clobber each other. ``stop()`` is
+idempotent and is ALSO called from every exit path (normal return, clean
+pause, preemption-requeue, crash) — a SIGTERM landing inside a capture
+window must still flush the trace file (the pre-telemetry code relied on a
+single ``finally``; the requeue path now stops the profiler explicitly
+before ``sys.exit`` as well, and ``tests/test_telemetry.py`` pins it).
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import events as telemetry_events
+
+
+class ProfilerController:
+    """Owns the bounded-capture state machine; one per training run."""
+
+    def __init__(
+        self,
+        *,
+        trace_path: str = "",
+        num_iters: int = 20,
+        trigger_path: str = "",
+        default_trace_dir: str = "",
+    ):
+        #: Start-of-run one-shot destination (the legacy flag); also the
+        #: base directory for triggered captures when set.
+        self.trace_path = str(trace_path or "")
+        self.num_iters = max(int(num_iters or 1), 1)
+        self.trigger_path = str(trigger_path or "")
+        self.default_trace_dir = str(default_trace_dir or "profiler_trace")
+        self._armed_at_start = bool(self.trace_path)
+        #: Set by request(); plain attribute writes only (signal-handler
+        #: safe). Consumed by tick() on the next dispatch.
+        self._pending_reason: str | None = None
+        self._profiling = False
+        self._iters_this_capture = 0
+        self._captures = 0
+        self._active_path: str | None = None
+
+    # ------------------------------------------------------------------
+    # Triggers
+    # ------------------------------------------------------------------
+
+    def request(self, reason: str = "signal") -> None:
+        """Arms a bounded capture from the next dispatch. Async-signal-safe
+        (one attribute write, no locks, no allocation-heavy work)."""
+        self._pending_reason = reason
+
+    def poll_trigger(self) -> None:
+        """File trigger check — call from forced-read boundaries only."""
+        if not self.trigger_path or not os.path.exists(self.trigger_path):
+            return
+        try:
+            os.remove(self.trigger_path)  # consume: one capture per touch
+        except OSError:
+            pass
+        self._pending_reason = "file"
+
+    # ------------------------------------------------------------------
+    # Capture state machine
+    # ------------------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._profiling
+
+    def tick(self, n_iters: int = 1) -> None:
+        """Advances the capture window by one dispatch of ``n_iters``
+        iterations; starts a pending capture, stops a full one."""
+        if not self._profiling:
+            if self._armed_at_start:
+                self._armed_at_start = False  # the legacy one-shot
+                self._begin(self.trace_path, reason="start_flag")
+            elif self._pending_reason is not None:
+                reason, self._pending_reason = self._pending_reason, None
+                base = self.trace_path or self.default_trace_dir
+                self._begin(
+                    os.path.join(base, f"on_demand_{self._captures}"),
+                    reason=reason,
+                )
+        if self._profiling:
+            self._iters_this_capture += n_iters
+            if self._iters_this_capture >= self.num_iters:
+                self.stop()
+
+    def _begin(self, path: str, reason: str) -> None:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        self._profiling = True
+        self._iters_this_capture = 0
+        self._captures += 1
+        self._active_path = path
+        telemetry_events.emit(
+            "profile_start", path=path, num_iters=self.num_iters,
+            reason=reason,
+        )
+        print(f"profiler trace started ({reason}) -> {path}", flush=True)
+
+    def stop(self) -> None:
+        """Flushes an in-flight capture; idempotent, called from every exit
+        path so short or interrupted runs still get a readable trace."""
+        if not self._profiling:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._profiling = False
+        telemetry_events.emit(
+            "profile_stop", path=self._active_path,
+            iters=self._iters_this_capture,
+        )
+        print("profiler trace stopped ->", self._active_path, flush=True)
+        self._active_path = None

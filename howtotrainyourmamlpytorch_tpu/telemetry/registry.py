@@ -1,0 +1,154 @@
+"""Shared metrics primitives: counters, gauges, exact-window quantiles.
+
+One implementation for BOTH runtimes: the serving frontend
+(``serve/metrics.py`` re-exports :class:`Counter` and :class:`LatencyStat`
+so its Prometheus surface is byte-identical to the pre-factoring one) and
+the trainer (``telemetry.runtime.TrainTelemetry`` keeps its step-time /
+data-wait / host-sync distributions in a :class:`MetricsRegistry`).
+
+Small and dependency-free by design (the container bakes no metrics
+client). Percentiles are computed EXACTLY over a bounded ring of recent
+samples rather than approximated from fixed histogram buckets — at serving
+rates the ring covers minutes of traffic, and the bench keys
+(``serve_adapt_p50_ms``; PERF_NOTES.md "Serving path") need real medians,
+not bucket midpoints. Cumulative ``count``/``sum`` still cover the full
+process lifetime, so rate math over scrapes stays correct.
+
+Everything here is thread-safe: HTTP scrape threads read while batcher/
+engine/builder threads record.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class LatencyStat:
+    """Cumulative count/sum plus exact percentiles over a recent window."""
+
+    def __init__(self, name: str, window: int = 2048):
+        self.name = name
+        self._lock = threading.Lock()
+        self._recent: deque[float] = deque(maxlen=window)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, value_ms: float) -> None:
+        with self._lock:
+            self._recent.append(float(value_ms))
+            self._count += 1
+            self._sum += float(value_ms)
+
+    def percentile(self, p: float) -> float:
+        """Exact percentile (nearest-rank) of the recent window; 0.0 when
+        empty."""
+        with self._lock:
+            if not self._recent:
+                return 0.0
+            ordered = sorted(self._recent)
+        rank = min(len(ordered) - 1, max(0, round(p / 100.0 * (len(ordered) - 1))))
+        return ordered[rank]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self._count, self._sum
+        return {
+            "count": count,
+            "sum_ms": total,
+            "p50_ms": self.percentile(50),
+            "p99_ms": self.percentile(99),
+        }
+
+    def quantile_snapshot(self, quantiles=(50, 95, 99)) -> dict:
+        """Like :meth:`snapshot` but with a caller-chosen quantile set —
+        the trainer's step-time breakdown wants p95 alongside p50/p99."""
+        with self._lock:
+            count, total = self._count, self._sum
+        out = {"count": count, "sum_ms": total}
+        for q in quantiles:
+            out[f"p{q:g}_ms"] = self.percentile(q)
+        return out
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self._value += by
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """A point-in-time value (e.g. the trainer's ``current_iter``, set per
+    dispatch and surfaced in every ``epoch_summary`` registry snapshot)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class MetricsRegistry:
+    """Named get-or-create store of the three primitives.
+
+    The trainer-side counterpart of ``serve/metrics.ServeMetrics`` (which
+    predates this registry and keeps its fixed attribute layout for the
+    Prometheus surface): callers materialize metrics lazily by name and
+    ``snapshot()`` renders everything for the JSONL event log / report
+    tooling.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._windows: dict[str, LatencyStat] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
+    def window(self, name: str, window: int = 2048) -> LatencyStat:
+        with self._lock:
+            if name not in self._windows:
+                self._windows[name] = LatencyStat(name, window=window)
+            return self._windows[name]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            windows = dict(self._windows)
+        return {
+            "counters": {name: c.value for name, c in counters.items()},
+            "gauges": {name: g.value for name, g in gauges.items()},
+            "windows": {
+                name: w.quantile_snapshot() for name, w in windows.items()
+            },
+        }

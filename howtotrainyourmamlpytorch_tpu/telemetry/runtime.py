@@ -1,0 +1,252 @@
+"""``TrainTelemetry``: the trainer's composition root for observability.
+
+Owns the per-run :class:`~.events.EventLog` (``logs/telemetry.jsonl``), a
+:class:`~.registry.MetricsRegistry` of run-wide distributions, and the
+:class:`~.profiling.ProfilerController`, and exposes exactly the hooks the
+``ExperimentBuilder`` loop needs:
+
+* ``record_dispatch`` — per-dispatch step-time sample, split into data-wait
+  (host blocked in ``next(batches)``, measured by the loader) vs device
+  dispatch (the remainder). Buffers one ``step`` event; NO device read, NO
+  I/O (zero new host syncs on the hot path — the compile/sync contract
+  ``tests/test_telemetry.py`` pins under ``compile_guard``).
+* ``boundary`` — the ``TRAIN_LOG_EVERY`` forced-read boundary: records the
+  host-sync cost of the log/sentinel read, polls the profiler file trigger,
+  and flushes the event buffer (the only hot-loop I/O point, riding a sync
+  that already exists).
+* ``epoch_stats`` — per-epoch p50/p95 of step time AND data wait for the
+  summary CSV (a slow loader is now distinguishable from a slow device),
+  plus an ``epoch_summary`` event carrying the registry snapshot.
+* ``activate`` — context manager installing the process-global event sink,
+  the XLA compile-event bridge (``utils/sanitize.compile_listener``), and
+  the ``SIGUSR1`` profile trigger; ``shutdown`` (idempotent) stops the
+  profiler and flushes from EVERY exit path, including preemption-requeue.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from ..utils.sanitize import compile_listener
+from . import events as telemetry_events
+from .events import EventLog
+from .profiling import ProfilerController
+from .registry import MetricsRegistry
+
+#: Compile-log signatures can run to kilobytes for large pytrees; the event
+#: log keeps enough to identify the (shape, dtype, K) class.
+_SIGNATURE_CHARS = 512
+
+
+class TrainTelemetry:
+    """One per ``ExperimentBuilder``; cheap to construct, safe when
+    ``enabled=False`` (step-time CSV stats and profiling still work; no
+    JSONL, no compile bridge, no global sink)."""
+
+    def __init__(
+        self,
+        logs_dir: str,
+        *,
+        enabled: bool = True,
+        profile_trace_path: str = "",
+        profile_num_iters: int = 20,
+        profile_trigger_path: str = "",
+    ):
+        self.enabled = bool(enabled)
+        self.logs_dir = logs_dir
+        self.events: EventLog | None = (
+            EventLog(os.path.join(logs_dir, "telemetry.jsonl"))
+            if self.enabled
+            else None
+        )
+        self.registry = MetricsRegistry()
+        self.profiler = ProfilerController(
+            trace_path=profile_trace_path,
+            num_iters=profile_num_iters,
+            trigger_path=(
+                profile_trigger_path
+                or os.path.join(logs_dir, "profile_trigger")
+            ),
+            default_trace_dir=os.path.join(logs_dir, "profiler_trace"),
+        )
+        self._last_dispatch_t: float | None = None
+        self._step_times: list[float] = []
+        self._data_waits: list[float] = []
+        self._ended = False
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Installs the global sink + compile bridge + SIGUSR1 trigger for
+        the duration of a run; guarantees ``shutdown`` on every exit."""
+        if not self.enabled:
+            try:
+                yield self
+            finally:
+                self.profiler.stop()
+            return
+        previous_sink = telemetry_events.install(self.events)
+        self.events.emit("run_start", pid=os.getpid())
+        previous_usr1 = self._install_usr1()
+        try:
+            with compile_listener(self._on_compile):
+                yield self
+        finally:
+            self.shutdown()
+            if previous_usr1 is not None:
+                try:
+                    signal.signal(signal.SIGUSR1, previous_usr1)
+                except (ValueError, OSError):
+                    pass
+            telemetry_events.install(previous_sink)
+
+    def _install_usr1(self):
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        try:
+            return signal.signal(
+                signal.SIGUSR1,
+                lambda signum, frame: self.profiler.request("signal"),
+            )
+        except (ValueError, OSError, AttributeError):  # embedded / non-posix
+            return None
+
+    def shutdown(self) -> None:
+        """Stops any in-flight profiler capture and flushes the event
+        buffer. Idempotent; called from the normal exit, the clean pause,
+        AND the preemption-requeue path (a SIGTERM inside a capture window
+        must still flush the trace)."""
+        self.profiler.stop()
+        if self.events is not None:
+            if not self._ended:
+                self._ended = True
+                self.events.emit("run_end")
+            self.events.flush()
+
+    # ------------------------------------------------------------------
+    # Hot-path hooks (buffer-only: no device reads, no I/O)
+    # ------------------------------------------------------------------
+
+    def event(self, event_type: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(event_type, **fields)
+
+    def record_dispatch(
+        self, upto_iter: int, n_iters: int = 1, data_wait_s: float = 0.0
+    ) -> None:
+        """One completed device dispatch ending at iteration ``upto_iter``
+        (``n_iters`` meta-updates; ``data_wait_s`` host time blocked on the
+        loader for its batches). The first dispatch after an epoch boundary
+        only drops the anchor — the val-epoch/checkpoint gap must not be
+        measured as a step."""
+        now = time.perf_counter()
+        self.registry.gauge("current_iter").set(upto_iter)
+        if self._last_dispatch_t is not None:
+            total_s = now - self._last_dispatch_t
+            device_s = max(total_s - data_wait_s, 0.0)
+            self._step_times.extend([total_s / n_iters] * n_iters)
+            self._data_waits.extend([data_wait_s / n_iters] * n_iters)
+            self.registry.window("step_time_ms").observe(1e3 * total_s / n_iters)
+            self.registry.window("data_wait_ms").observe(
+                1e3 * data_wait_s / n_iters
+            )
+            self.registry.counter("train_dispatches").inc()
+            if self.events is not None:
+                self.events.emit(
+                    "step",
+                    iter=int(upto_iter),
+                    k=int(n_iters),
+                    step_s=total_s,
+                    data_wait_s=data_wait_s,
+                    device_s=device_s,
+                )
+        self._last_dispatch_t = now
+        self.profiler.tick(n_iters)
+
+    # ------------------------------------------------------------------
+    # Forced-read boundaries (the only I/O points)
+    # ------------------------------------------------------------------
+
+    def boundary(self, current_iter: int, sync_s: float, reason: str) -> None:
+        """A point that already forced a device read (log cadence, epoch
+        summary): record its host-sync cost, poll the profiler file
+        trigger, flush buffered events."""
+        self.registry.window("host_sync_ms").observe(1e3 * sync_s)
+        if self.events is not None:
+            self.events.emit(
+                "host_sync", iter=int(current_iter), sync_s=sync_s,
+                reason=reason,
+            )
+        self.profiler.poll_trigger()
+        self.flush()
+
+    def epoch_stats(self, phase: str = "train", epoch: int | None = None) -> dict:
+        """Pops the epoch's per-iteration samples into the summary-CSV keys
+        — step time AND data wait, so a slow loader is distinguishable from
+        a slow device in the per-epoch record. STABLE SCHEMA: emits the
+        keys as NaN rather than omitting them (an epoch with <2 dispatches
+        must not write a short, silently misaligned CSV row)."""
+        # Always drop the anchor at epoch end: the next epoch's first
+        # dispatch must not measure the val-epoch + checkpoint gap.
+        self._last_dispatch_t = None
+        steps, self._step_times = self._step_times, []
+        waits, self._data_waits = self._data_waits, []
+        if steps:
+            step_arr = np.asarray(steps)
+            wait_arr = np.asarray(waits)
+            stats = {
+                f"{phase}_step_time_p50": float(np.percentile(step_arr, 50)),
+                f"{phase}_step_time_p95": float(np.percentile(step_arr, 95)),
+                f"{phase}_data_wait_p50": float(np.percentile(wait_arr, 50)),
+                f"{phase}_data_wait_p95": float(np.percentile(wait_arr, 95)),
+            }
+        else:
+            stats = {
+                f"{phase}_step_time_p50": float("nan"),
+                f"{phase}_step_time_p95": float("nan"),
+                f"{phase}_data_wait_p50": float("nan"),
+                f"{phase}_data_wait_p95": float("nan"),
+            }
+        if self.events is not None:
+            self.events.emit(
+                "epoch_summary",
+                epoch=epoch,
+                iters=len(steps),
+                metrics=self.registry.snapshot(),
+                **stats,
+            )
+        return stats
+
+    def reset_window(self) -> None:
+        """Divergence-rollback reset: abandon the partial epoch's samples
+        and the dispatch anchor (the replay starts a fresh window)."""
+        self._last_dispatch_t = None
+        self._step_times = []
+        self._data_waits = []
+
+    def flush(self) -> None:
+        if self.events is not None:
+            self.events.flush()
+
+    # ------------------------------------------------------------------
+
+    def _on_compile(self, event) -> None:
+        """Bridge from ``utils/sanitize.compile_listener``: one event per
+        XLA compile, named + signature-indexed (the recompile classes the
+        compile guard pins)."""
+        self.registry.counter("xla_compiles").inc()
+        if self.events is not None:
+            self.events.emit(
+                "compile",
+                name=event.name,
+                signature=event.signature[:_SIGNATURE_CHARS],
+            )

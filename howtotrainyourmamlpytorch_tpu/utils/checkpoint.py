@@ -41,6 +41,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from ..telemetry import events as telemetry_events
 from . import faultinject
 
 Tree = Any
@@ -124,6 +125,7 @@ def save_checkpoint(
     Device arrays are fetched with ONE batched ``jax.device_get`` — per-leaf
     ``np.asarray`` costs a full device round trip each (~10 s per save
     through the axon tunnel vs ~0.2 s batched)."""
+    t_start = time.perf_counter()
     host_leaves, treedef = jax.tree.flatten(state_tree)
     host_leaves = jax.device_get(host_leaves)
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(host_leaves)}
@@ -161,6 +163,13 @@ def save_checkpoint(
     if last_error is not None:
         raise last_error
     faultinject.checkpoint_written(filepath)
+    telemetry_events.emit(
+        "checkpoint_save",
+        path=os.path.basename(filepath),
+        duration_s=time.perf_counter() - t_start,
+        bytes=os.path.getsize(filepath),
+        attempts=attempt + 1,
+    )
     return filepath
 
 
@@ -179,6 +188,7 @@ def publish_alias(
     mutates an existing file in place. Transient ``OSError`` is retried
     with the same budget as ``save_checkpoint`` — the retry contract covers
     BOTH halves of the epoch checkpoint publish."""
+    t_start = time.perf_counter()
     tmp = dst + ".alias.tmp"
     last_error: OSError | None = None
     for attempt in range(max(int(retries), 1)):
@@ -206,6 +216,12 @@ def publish_alias(
     if last_error is not None:
         raise last_error
     faultinject.checkpoint_written(dst)
+    telemetry_events.emit(
+        "checkpoint_alias",
+        path=os.path.basename(dst),
+        src=os.path.basename(src),
+        duration_s=time.perf_counter() - t_start,
+    )
     return dst
 
 
@@ -329,6 +345,7 @@ def load_checkpoint(
     outage at resume time can never cascade-quarantine healthy checkpoints.
     Archives without a manifest (pre-schema legacy files) load with the
     structural checks only."""
+    t_start = time.perf_counter()
     template_leaves, treedef = jax.tree.flatten(template_tree)
     n_template = len(template_leaves)
     leaves, manifest, experiment_state = _read_verified(
@@ -351,6 +368,12 @@ def load_checkpoint(
         )
 
     restored = _restore_prefix(filepath, template_leaves, leaves)
+    telemetry_events.emit(
+        "checkpoint_load",
+        path=os.path.basename(filepath),
+        duration_s=time.perf_counter() - t_start,
+        leaves=n_template,
+    )
     return jax.tree.unflatten(treedef, restored), experiment_state
 
 

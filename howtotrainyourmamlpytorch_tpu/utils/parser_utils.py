@@ -146,8 +146,24 @@ def get_parser() -> argparse.ArgumentParser:
         help="0 = all local devices; shards the task axis over the mesh")
     add("--profile_trace_path", type=str, default="",
         help="when set, jax.profiler-trace the first profile_num_iters "
-             "train iterations into this directory")
-    add("--profile_num_iters", type=int, default=20)
+             "train iterations into this directory (also the base dir for "
+             "on-demand triggered captures)")
+    add("--profile_num_iters", type=int, default=20,
+        help="iterations per bounded profiler capture (start-of-run flag "
+             "AND every on-demand trigger)")
+    add("--profile_trigger_path", type=str, default="",
+        help="on-demand profiling trigger file (default "
+             "<experiment>/logs/profile_trigger): touching it mid-run "
+             "captures a bounded jax.profiler trace of the next "
+             "profile_num_iters iterations; SIGUSR1 does the same")
+    # Telemetry subsystem (telemetry/ + tools/telemetry_report.py): the
+    # structured run-event log logs/telemetry.jsonl — step-time breakdown
+    # (data-wait vs device vs host-sync), XLA compile events, checkpoint
+    # durations, sentinel/preemption events. Buffered on the host and
+    # flushed only at forced-read boundaries: zero new host syncs.
+    add("--telemetry", type=str, default="True",
+        help="False disables the structured event log (step-time CSV "
+             "percentiles and profiling still work)")
     # Trace-time sanitizers (opt-in, process-global jax.config switches;
     # see utils/sanitize.py and README "Static analysis & sanitizers").
     add("--debug_nans", type=str, default="False",

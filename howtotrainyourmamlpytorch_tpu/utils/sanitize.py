@@ -98,27 +98,30 @@ class CompileGuard:
 
 
 class _CompileLogHandler(logging.Handler):
-    def __init__(self, guard: CompileGuard):
+    def __init__(self, callback):
         super().__init__(level=logging.DEBUG)
-        self._guard = guard
+        self._callback = callback
 
     def emit(self, record: logging.LogRecord) -> None:
         match = _COMPILE_RE.search(record.getMessage())
         if match:
-            self._guard.events.append(
+            self._callback(
                 CompileEvent(name=match.group("name"),
                              signature=match.group("signature"))
             )
 
 
 @contextlib.contextmanager
-def compile_guard():
-    """Context manager: yields a :class:`CompileGuard` recording every XLA
-    compile in the block. Reentrant-safe; restores logger state on exit."""
+def compile_listener(callback):
+    """Invokes ``callback(CompileEvent)`` for every XLA compile in the
+    block — the shared listener under BOTH consumers: the test-facing
+    :func:`compile_guard` (assertion budget) and the telemetry subsystem's
+    compile-event bridge (``telemetry.runtime.TrainTelemetry``, which turns
+    each event into a ``logs/telemetry.jsonl`` line). Reentrant-safe;
+    restores logger state on exit."""
     import jax
 
-    guard = CompileGuard()
-    handler = _CompileLogHandler(guard)
+    handler = _CompileLogHandler(callback)
     logger = logging.getLogger(_COMPILE_LOGGER)
     old_level = logger.level
     logger.addHandler(handler)
@@ -126,9 +129,34 @@ def compile_guard():
     # log_compiles emits at WARNING so DEBUG-level capture is unaffected.
     if logger.level > logging.WARNING or logger.level == logging.NOTSET:
         logger.setLevel(logging.WARNING)
+    # Quiet the console while listening: jax.log_compiles() makes the pxla
+    # and dispatch loggers emit multi-line WARNING records per compile,
+    # which would spam every telemetry-on training run's stderr. Handlers
+    # attached directly to the logger (this one, and any nested listener's)
+    # still fire with propagation off; each quieted logger also gets a
+    # NullHandler so logging's bare-print lastResort fallback (which fires
+    # whenever a record finds NO handler) stays silent too.
+    quieted = [logger, logging.getLogger("jax._src.dispatch")]
+    old_propagate = [lg.propagate for lg in quieted]
+    null_handlers = [logging.NullHandler() for _ in quieted]
+    for lg, null_handler in zip(quieted, null_handlers):
+        lg.propagate = False
+        lg.addHandler(null_handler)
     try:
         with jax.log_compiles():
-            yield guard
+            yield
     finally:
         logger.removeHandler(handler)
         logger.setLevel(old_level)
+        for lg, prop, null_handler in zip(quieted, old_propagate, null_handlers):
+            lg.removeHandler(null_handler)
+            lg.propagate = prop
+
+
+@contextlib.contextmanager
+def compile_guard():
+    """Context manager: yields a :class:`CompileGuard` recording every XLA
+    compile in the block. Reentrant-safe; restores logger state on exit."""
+    guard = CompileGuard()
+    with compile_listener(guard.events.append):
+        yield guard

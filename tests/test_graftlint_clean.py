@@ -72,6 +72,40 @@ def test_serve_subsystem_lints_clean_standalone():
     assert lint_paths([serve_dir]) == []
 
 
+def test_telemetry_subsystem_lints_clean_standalone():
+    """The telemetry subsystem (ISSUE 5) stays lint-clean as its own target
+    with ZERO suppressions: the whole-package gate covers it transitively,
+    but this pin survives any future LINT_TARGETS reshuffle. Also asserts
+    the linter actually DISCOVERED the telemetry modules (an empty scan
+    would vacuously pass) and that no inline suppressions crept in."""
+    telemetry_dir = os.path.join(
+        REPO, "howtotrainyourmamlpytorch_tpu", "telemetry"
+    )
+    report_tool = os.path.join(REPO, "tools", "telemetry_report.py")
+    assert os.path.isdir(telemetry_dir)
+    proc = run_cli(telemetry_dir, report_tool)
+    assert proc.returncode == 0, (
+        "graftlint found violations in the telemetry subsystem:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "graftlint: clean" in proc.stderr
+
+    from tools.graftlint import lint_paths
+    from tools.graftlint.engine import _collect_files
+
+    scanned = _collect_files([telemetry_dir, report_tool])
+    names = {os.path.basename(p) for p in scanned}
+    assert {
+        "registry.py", "events.py", "profiling.py", "runtime.py",
+        "telemetry_report.py",
+    } <= names
+    assert lint_paths([telemetry_dir, report_tool]) == []
+    # Zero suppressions: the subsystem must be clean on its own merits.
+    for path in scanned:
+        with open(path) as f:
+            assert "graftlint: disable" not in f.read(), path
+
+
 def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(

@@ -16,7 +16,10 @@ with the PERF_NOTES.md "Serving path" keys:
                              cached (the adapted-params cache's best case:
                              classify-only);
 * ``serve_compiles``       — compile-table size + total traces at exit
-                             (the zero-per-request-recompile receipt).
+                             (the zero-per-request-recompile receipt);
+* ``telemetry_overhead_pct`` — hot-path cost of the structured event sink
+                             (``telemetry/events.py``): cache-hit qps with
+                             a sink installed vs without, back-to-back.
 
 Usage: ``python tools/serve_bench.py [--tiny] [--budget-s 5]``
 (``--tiny`` runs a 2-stage 14x14 net — CI-sized; default is the flagship
@@ -144,10 +147,51 @@ def main(argv=None) -> int:
     classify = api.metrics.classify_latency.snapshot()
 
     # Hot path: one episode repeated — every request hits the cache.
+    # Measured as PAIRED alternating windows, half with a structured event
+    # sink installed (the engine then buffers one serve_dispatch event per
+    # device dispatch — telemetry/events.py): each pair runs back-to-back
+    # so its overhead delta sees the same machine state, pair order
+    # alternates so host drift cancels, and telemetry_overhead_pct is the
+    # median of per-pair deltas (an unpaired sequential comparison just
+    # measures shared-host noise — same protocol as
+    # tools/telemetry_report.py --overhead-bench).
+    import statistics
+    import tempfile
+
+    from howtotrainyourmamlpytorch_tpu.telemetry import EventLog
+    from howtotrainyourmamlpytorch_tpu.telemetry import (
+        events as telemetry_events,
+    )
+
     hot_pool = episode_pool(api, n=1, shot=opts.shot, query=opts.query, seed=7)
     xs, ys, xq = hot_pool[0]
     api.classify(xs, ys, xq)  # prime the cache entry
-    cache_hit_qps = offered_qps(api, hot_pool, opts.budget_s, opts.threads)
+    log = EventLog(
+        os.path.join(
+            tempfile.mkdtemp(prefix="serve_telemetry_"), "telemetry.jsonl"
+        )
+    )
+    hot_windows = 3
+    per_window = opts.budget_s / (2 * hot_windows)
+    plain_rates, telemetry_rates, pair_overheads = [], [], []
+    for w in range(hot_windows):
+        pair = {}
+        order = (False, True) if w % 2 == 0 else (True, False)
+        for with_sink in order:
+            previous_sink = telemetry_events.install(log if with_sink else None)
+            try:
+                rate = offered_qps(api, hot_pool, per_window, opts.threads)
+            finally:
+                telemetry_events.install(previous_sink)
+            pair[with_sink] = rate
+            (telemetry_rates if with_sink else plain_rates).append(rate)
+        pair_overheads.append(
+            (pair[False] - pair[True]) / pair[False] * 100.0
+        )
+    log.flush()
+    cache_hit_qps = statistics.median(plain_rates)
+    telemetry_qps = statistics.median(telemetry_rates)
+    telemetry_overhead_pct = statistics.median(pair_overheads)
 
     compile_table = api.engine.compile_table()
     result = {
@@ -168,6 +212,11 @@ def main(argv=None) -> int:
         "serve_cache_hit_rate_final": round(
             api.metrics.cache_hit_rate(), 4
         ),
+        "serve_telemetry_qps": round(telemetry_qps, 3),
+        "telemetry_overhead_pct": round(telemetry_overhead_pct, 3),
+        "telemetry_pair_overheads_pct": [
+            round(o, 3) for o in pair_overheads
+        ],
         "serve_compiles": {
             "programs": len(compile_table),
             "total_traces": sum(compile_table.values()),
