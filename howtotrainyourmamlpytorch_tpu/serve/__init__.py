@@ -2,29 +2,51 @@
 
 The production inference workload MAML exists for (PAPER.md): load a
 trained initialization, adapt to a request's support set in a few gradient
-steps, answer its queries — at traffic, without per-request XLA compiles.
+steps, answer its queries — at traffic, without per-request XLA compiles,
+and without a single process being a single point of failure.
 
 Layers (each its own module, composable without the HTTP frontend):
 
-* ``engine``  — shape-bucketed compiled adapt/classify program pairs with
-  task-axis padding; the zero-recompile contract.
-* ``batcher`` — deadline micro-batching: concurrent episodes share one
-  meta-batch dispatch.
-* ``cache``   — LRU adapted-params cache keyed by support-set digest;
+* ``engine``     — shape-bucketed compiled adapt/classify program pairs
+  with task-axis padding; the zero-recompile contract; atomic state
+  publication + hot-swap canary probes.
+* ``batcher``    — deadline micro-batching: concurrent episodes share one
+  meta-batch dispatch; the worker thread is fenced (a poisoned episode
+  fails its own group, never the queue).
+* ``cache``      — LRU adapted-params cache keyed by support-set digest;
   repeat support sets skip the inner loop.
-* ``metrics`` — latency quantiles / counters / Prometheus text.
-* ``api``     — ``ServingAPI`` (in-process) + the stdlib HTTP frontend
-  (``/v1/episode``, ``/healthz``, ``/metrics``).
+* ``metrics``    — latency quantiles / counters / Prometheus text.
+* ``errors``     — the typed failure surface (shed / deadline / replica
+  death / swap rejection).
+* ``resilience`` — admission control, safe hot-swap (manifest verify +
+  canary + publish), and the replica flavors the pool supervises.
+* ``pool``       — N replicas behind one front door: health-checked,
+  crash-restarted with backoff + circuit breaker, re-dispatch on death.
+* ``api``        — ``ServingAPI`` (in-process) + the stdlib HTTP frontend
+  (``/v1/episode``, ``/admin/promote``, ``/healthz``, ``/metrics``),
+  bindable over one engine or a whole pool.
 
-Entry points: ``tools/serve_maml.py`` (server CLI), ``tools/serve_bench.py``
-(bench keys: ``serve_qps`` / ``serve_adapt_p50_ms`` / ``serve_cache_hit_qps``).
+Entry points: ``tools/serve_maml.py`` (server CLI, ``--replicas N`` for a
+supervised pool), ``tools/serve_bench.py`` (bench keys), and
+``tools/serve_loadtest.py`` (open-loop SLO verdict: p99 budget + error
+rate + recovery time).
 """
 
 from .api import ServingAPI, make_http_server
 from .batcher import MicroBatcher
 from .cache import AdaptedParamsCache, support_digest
 from .engine import EpisodeRequest, ServeConfig, ServingEngine
+from .errors import (
+    DeadlineExceededError,
+    DispatchFailedError,
+    NoHealthyReplicaError,
+    OverloadedError,
+    ReplicaDeadError,
+    ServeError,
+    SwapRejectedError,
+)
 from .metrics import ServeMetrics
+from .pool import PoolConfig, ReplicaPool
 
 __all__ = [
     "ServingAPI",
@@ -36,4 +58,13 @@ __all__ = [
     "ServeConfig",
     "ServingEngine",
     "ServeMetrics",
+    "ServeError",
+    "OverloadedError",
+    "NoHealthyReplicaError",
+    "DeadlineExceededError",
+    "DispatchFailedError",
+    "ReplicaDeadError",
+    "SwapRejectedError",
+    "PoolConfig",
+    "ReplicaPool",
 ]

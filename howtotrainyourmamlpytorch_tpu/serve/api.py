@@ -1,47 +1,76 @@
 """Serving frontends: the in-process Python API and the stdlib HTTP server.
 
-``ServingAPI`` is the composition root — engine + batcher + cache + metrics
-behind one synchronous ``classify`` call — and is what embedders (and the
-bench harness, ``tools/serve_bench.py``) use directly. The HTTP frontend is
-a deliberately minimal ``http.server`` wrapper over the same object: one
-POST route for episodes plus the two operational endpoints every fleet
-scraper assumes (``/healthz``, ``/metrics``). No framework — the container
-bakes no web dependencies, and the device pipeline (one batcher worker) is
-the throughput ceiling anyway, not HTTP parsing.
+``ServingAPI`` is the composition root — engine + batcher + cache +
+admission control + metrics behind one synchronous ``classify`` call — and
+is what embedders (and the bench harness, ``tools/serve_bench.py``) use
+directly. The HTTP frontend is a deliberately minimal ``http.server``
+wrapper over the same object: one POST route for episodes, one admin route
+for safe checkpoint promotion, plus the two operational endpoints every
+fleet scraper assumes (``/healthz``, ``/metrics``). No framework — the
+container bakes no web dependencies, and the device pipeline is the
+throughput ceiling anyway, not HTTP parsing.
+
+The frontend binds EITHER a ``ServingAPI`` (one engine) or a
+``serve/pool.ReplicaPool`` (N supervised worker replicas) — both quack the
+same classify/healthz/stats/metrics_text/promote surface.
 
 Endpoints::
 
-    POST /v1/episode   {"support": [...], "support_labels": [...],
-                        "query": [...]}
-                       -> {"logits": [[...]], "predictions": [...],
-                           "cache_hit": bool, "bucket": "5x1x15", ...}
-    GET  /healthz      -> {"status": "ok", ...}
-    GET  /metrics      -> Prometheus text (latency p50/p99 for adapt and
-                          classify, queue depth, cache hit rate, per-bucket
-                          episode + compile tables)
+    POST /v1/episode     {"support": [...], "support_labels": [...],
+                          "query": [...]}
+                         -> 200 {"logits": [[...]], "predictions": [...],
+                                 "cache_hit": bool, "bucket": "5x1x15", ...}
+                         -> 503 + Retry-After when shed (admission control
+                            or no healthy replica), 503 on deadline, 400 on
+                            malformed episodes
+    POST /admin/promote  {"checkpoint": "<path>"} — safe hot-swap: manifest
+                         verify + canary episodes, 409 on rejection (the
+                         old state keeps serving)
+    GET  /healthz        -> 200 {"status": "ok", "ready": true, ...} once
+                            warmed; 503 with ``ready: false`` before the
+                            engine has ever produced logits; ``degraded``
+                            reflects live shedding, queue depth/age and
+                            last-dispatch age ride along (an honest health
+                            surface, not an unconditional "ok")
+    GET  /metrics        -> Prometheus text (latency p50/p99, queue depth,
+                            shed/deadline/swap counters, cache hit rate,
+                            per-bucket episode + compile tables)
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..utils import faultinject
 from .batcher import MicroBatcher
 from .engine import ServeConfig, ServingEngine
+from .errors import DeadlineExceededError, OverloadedError, SwapRejectedError
 from .metrics import ServeMetrics
+from .resilience.admission import AdmissionController
+from .resilience.swap import promote_checkpoint, promote_state
 
 #: Hard cap on request body bytes (a 64 MB episode is ~200 84x84x3 images
 #: as JSON — anything bigger is a malformed or hostile request).
 MAX_BODY_BYTES = 64 * 1024 * 1024
 
+#: Exit code of a worker process killed by the ``replica_kill_at_request``
+#: fault — distinguishable from a real crash in pool logs.
+REPLICA_KILL_EXIT = 86
+
+#: How long a WEDGED handler stalls (the ``wedge_replica_at_request``
+#: fault): long enough that every client/supervisor timeout fires first.
+_WEDGE_STALL_S = 3600.0
+
 
 class ServingAPI:
     """In-process few-shot serving: adapt+classify episodes against one
-    loaded checkpoint."""
+    loaded checkpoint, behind admission control."""
 
     def __init__(self, learner, state, config: ServeConfig | None = None):
         self.metrics = ServeMetrics()
@@ -49,6 +78,7 @@ class ServingAPI:
             learner, state, config=config, metrics=self.metrics
         )
         self.batcher = MicroBatcher(self.engine)
+        self.admission = AdmissionController(self.engine.config, self.metrics)
         self.started_at = time.time()
         self._closed = False
 
@@ -62,9 +92,12 @@ class ServingAPI:
         Returns ``logits`` (``(T, num_classes)`` float32), per-query
         ``predictions``, whether the adapted params came from cache, and
         the shape bucket the episode rode. Raises ``ValueError`` for
-        malformed episodes and builtin ``TimeoutError`` if the deadline
-        passes (``concurrent.futures.TimeoutError`` is translated — on
-        Python < 3.11 they are distinct classes)."""
+        malformed episodes, ``OverloadedError`` (a 503) when admission
+        control sheds the request, and ``DeadlineExceededError`` (a
+        ``TimeoutError`` subclass — the pre-resilience contract) when the
+        ``timeout`` budget runs out. The budget is propagated as an
+        absolute deadline through batcher and engine, so an expired
+        request is dropped from the queue instead of dispatched."""
         t0 = time.perf_counter()
         # Counted on OFFER, not success: a server failing every request
         # must not look idle on a dashboard.
@@ -74,12 +107,27 @@ class ServingAPI:
                 x_support, y_support, x_query
             )
             cache_hit = episode.digest in self.engine.cache
+            self.admission.admit(
+                queue_depth=self.batcher.queue_depth(),
+                oldest_age_s=self.batcher.oldest_pending_age_s(),
+                cache_hit=cache_hit,
+            )
+            if timeout is not None:
+                episode.deadline = time.monotonic() + float(timeout)
             future = self.batcher.submit(episode)
             try:
                 logits = future.result(timeout=timeout)
+            except DeadlineExceededError:
+                # The batcher already failed (and counted) this request as
+                # queue-expired. Re-raise as-is — on Python >= 3.11
+                # concurrent.futures.TimeoutError IS builtin TimeoutError,
+                # so without this clause the branch below would double-count
+                # it and swallow the batcher's message.
+                raise
             except futures.TimeoutError:
                 future.cancel()
-                raise TimeoutError(
+                self.metrics.deadline_exceeded_total.inc()
+                raise DeadlineExceededError(
                     f"dispatch exceeded the {timeout} s deadline"
                 ) from None
         except Exception:
@@ -95,16 +143,64 @@ class ServingAPI:
         }
 
     def update_state(self, state) -> int:
-        """Hot-swaps the served checkpoint (see ``ServingEngine``)."""
+        """RAW hot-swap (no verification, no canary) — kept for embedders
+        that already validated the state; ``promote`` is the safe path."""
         return self.engine.update_state(state)
 
-    def healthz(self) -> dict:
+    def promote(self, checkpoint_path=None, *, state=None, buckets=None) -> dict:
+        """Safe hot-swap (``serve/resilience/swap.py``): manifest-verify
+        (checkpoint path form), canary every warmed bucket against the
+        candidate, publish only on success. Raises ``SwapRejectedError``
+        with the old state still serving."""
+        if (checkpoint_path is None) == (state is None):
+            raise ValueError(
+                "promote takes exactly one of checkpoint_path or state"
+            )
+        if checkpoint_path is not None:
+            result = promote_checkpoint(
+                self.engine, checkpoint_path, buckets=buckets
+            )
+        else:
+            result = promote_state(self.engine, state, buckets=buckets)
         return {
-            "status": "ok",
+            "state_version": result.version,
+            "buckets_canaried": len(result.buckets_canaried),
+            "source": result.source,
+        }
+
+    def healthz(self) -> dict:
+        """Honest health: readiness (503 until the engine has produced
+        logits at least once), live degradation state, queue depth/age,
+        and last-dispatch age — the signals a supervisor or load balancer
+        actually routes on."""
+        queue_depth = self.batcher.queue_depth()
+        oldest_age_s = self.batcher.oldest_pending_age_s()
+        ready = self.engine.ready
+        degraded = self.admission.degraded(queue_depth, oldest_age_s)
+        if not ready:
+            status = "unready"
+        elif degraded:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "ready": ready,
+            "degraded": degraded,
             "family": self.engine.family,
             "state_version": self.engine.state_version,
             "uptime_s": time.time() - self.started_at,
             "episodes_served": self.metrics.episodes_served.value,
+            "queue_depth": queue_depth,
+            "oldest_pending_age_s": round(oldest_age_s, 4),
+            "last_dispatch_age_s": round(
+                self.batcher.last_dispatch_age_s(), 4
+            ),
+            "shed_total": self.metrics.shed_total.value,
+            "warmed_buckets": [
+                "x".join(str(d) for d in b)
+                for b in self.engine.warmed_buckets()
+            ],
         }
 
     def stats(self) -> dict:
@@ -131,31 +227,53 @@ class ServingAPI:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    """Routes requests onto the bound ``ServingAPI`` (set by
-    ``make_http_server``)."""
+    """Routes requests onto the bound ``ServingAPI`` / ``ReplicaPool``
+    (set by ``make_http_server``)."""
 
-    api: ServingAPI  # bound per-server subclass
+    api: ServingAPI  # bound per-server subclass (or a ReplicaPool)
+    #: True when this server IS a replica (single-engine worker): the
+    #: serve-path fault hooks (kill/wedge) fire here. A pool front door
+    #: must never consume them — its replicas do.
+    consult_faults = True
     protocol_version = "HTTP/1.1"
 
     # Quiet by default: serving logs belong to metrics, not stderr spam.
     def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
         pass
 
-    def _send(self, code: int, body: bytes, content_type: str) -> None:
+    def _stalled(self) -> bool:
+        """The wedge fault: an unresponsive-but-alive worker. Handlers
+        stall instead of answering, so clients and the pool supervisor see
+        exactly what a GIL-stuck or device-hung process looks like."""
+        if getattr(self.server, "wedged", False):
+            time.sleep(_WEDGE_STALL_S)
+            return True
+        return False
+
+    def _send(self, code: int, body: bytes, content_type: str,
+              extra_headers: dict | None = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(
+        self, code: int, payload: dict, extra_headers: dict | None = None
+    ) -> None:
         self._send(
-            code, json.dumps(payload).encode(), "application/json"
+            code, json.dumps(payload).encode(), "application/json",
+            extra_headers,
         )
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        if self._stalled():
+            return
         if self.path == "/healthz":
-            self._send_json(200, self.api.healthz())
+            payload = self.api.healthz()
+            self._send_json(200 if payload.get("ready") else 503, payload)
         elif self.path == "/metrics":
             self._send(
                 200, self.api.metrics_text().encode(), "text/plain; version=0.0.4"
@@ -163,29 +281,58 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
+    def _read_body(self) -> dict | None:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._send_json(
+                413 if length > MAX_BODY_BYTES else 400,
+                {"error": f"bad Content-Length {length}"},
+            )
+            return None
+        return json.loads(self.rfile.read(length))
+
     def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
-        if self.path != "/v1/episode":
-            self._send_json(404, {"error": f"no route {self.path}"})
+        if self._stalled():
             return
+        if self.path == "/v1/episode":
+            self._post_episode()
+        elif self.path == "/admin/promote":
+            self._post_promote()
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def _post_episode(self) -> None:
+        if self.consult_faults:
+            fault = faultinject.serve_request_fault()
+            if fault == "kill":
+                # A worker crash, faithfully: no response, no cleanup, the
+                # process is gone. The pool sees a dropped connection.
+                os._exit(REPLICA_KILL_EXIT)
+            elif fault == "wedge":
+                self.server.wedged = True
+                if self._stalled():
+                    return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            if length <= 0 or length > MAX_BODY_BYTES:
-                self._send_json(
-                    413 if length > MAX_BODY_BYTES else 400,
-                    {"error": f"bad Content-Length {length}"},
-                )
+            payload = self._read_body()
+            if payload is None:
                 return
-            payload = json.loads(self.rfile.read(length))
             result = self.api.classify(
                 payload["support"],
                 payload["support_labels"],
                 payload["query"],
             )
+        except OverloadedError as exc:
+            self._send_json(
+                503,
+                {"error": str(exc), "shed": True},
+                {"Retry-After": f"{exc.retry_after_s:g}"},
+            )
+            return
         except (KeyError, ValueError, TypeError) as exc:
             self._send_json(400, {"error": str(exc)})
             return
-        except TimeoutError:
-            self._send_json(503, {"error": "dispatch timed out"})
+        except TimeoutError as exc:
+            self._send_json(503, {"error": f"dispatch timed out: {exc}"})
             return
         except Exception as exc:  # dispatch failure: visible, not a hang
             self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
@@ -201,13 +348,44 @@ class _Handler(BaseHTTPRequestHandler):
             },
         )
 
+    def _post_promote(self) -> None:
+        try:
+            payload = self._read_body()
+            if payload is None:
+                return
+            result = self.api.promote(payload["checkpoint"])
+        except SwapRejectedError as exc:
+            self._send_json(
+                409, {"error": str(exc), "reason": exc.reason}
+            )
+            return
+        except (KeyError, ValueError, TypeError) as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        except Exception as exc:
+            self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._send_json(200, result)
+
 
 def make_http_server(
-    api: ServingAPI, host: str = "127.0.0.1", port: int = 0
+    api, host: str = "127.0.0.1", port: int = 0
 ) -> ThreadingHTTPServer:
-    """Builds (does not start) the HTTP server; ``port=0`` binds an
-    ephemeral port — read it back from ``server.server_address``. Run with
-    ``serve_forever()`` (blocking) or a daemon thread (tests, embedders)."""
+    """Builds (does not start) the HTTP server over a ``ServingAPI`` or a
+    ``ReplicaPool``; ``port=0`` binds an ephemeral port — read it back from
+    ``server.server_address``. Run with ``serve_forever()`` (blocking) or a
+    daemon thread (tests, embedders)."""
 
-    handler = type("BoundServeHandler", (_Handler,), {"api": api})
-    return ThreadingHTTPServer((host, port), handler)
+    handler = type(
+        "BoundServeHandler",
+        (_Handler,),
+        {
+            "api": api,
+            # Worker-process faults belong to replicas; a pool front door
+            # passes them through untouched.
+            "consult_faults": not getattr(api, "is_replica_pool", False),
+        },
+    )
+    server = ThreadingHTTPServer((host, port), handler)
+    server.wedged = False
+    return server

@@ -5,8 +5,9 @@ with a vmapped meta-batch axis, so the device program is already shaped to
 answer B episodes for barely more than the cost of one — the batcher's job
 is to refill that axis from CONCURRENT traffic. Each incoming episode
 joins the pending group for its shape bucket; a group flushes when it
-reaches ``max_batch`` episodes (the engine's fixed meta-batch) or when its
-oldest request has waited ``max_wait_ms`` — the classic
+reaches ``max_batch`` episodes (the engine's fixed meta-batch), when its
+oldest request has waited ``max_wait_ms``, or when the tightest member
+DEADLINE would otherwise expire in the queue — the classic
 latency-vs-throughput dial (0 ms degenerates to per-request dispatch,
 large values trade tail latency for device efficiency).
 
@@ -15,6 +16,15 @@ One worker thread owns all dispatching; callers block on a
 arbitrarily many frontend threads (the HTTP handler pool) share one device
 pipeline. Dispatch runs OUTSIDE the queue lock — enqueue latency never
 includes device time.
+
+Resilience contract (serve/errors.py): the worker thread is FENCED. An
+exception anywhere in a group's dispatch — a poisoned episode deep in the
+engine, a result-count mismatch, even a set_result race against a caller's
+timeout-cancel — fails THAT group's futures with ``DispatchFailedError``
+and keeps the worker alive; it must never strand every queued Future in
+the process behind a dead thread. Episodes whose deadline has already
+passed are dropped before dispatch (``DeadlineExceededError``): running
+work nobody is waiting for would stretch every later request's queue time.
 """
 
 from __future__ import annotations
@@ -22,20 +32,39 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 from .engine import EpisodeRequest, ServingEngine
+from .errors import DeadlineExceededError, DispatchFailedError
 
 
 class _Group:
-    """Pending episodes of one bucket + the oldest-request deadline."""
+    """Pending episodes of one bucket + the flush deadline (the earlier of
+    oldest-arrival + max_wait and the tightest member request deadline)."""
 
-    __slots__ = ("episodes", "futures", "deadline")
+    __slots__ = ("episodes", "futures", "deadline", "created")
 
-    def __init__(self, deadline: float):
+    def __init__(self, deadline: float, created: float):
         self.episodes: list[EpisodeRequest] = []
         self.futures: list[Future] = []
         self.deadline = deadline
+        self.created = created
+
+
+def _fail(future: Future, exc: Exception) -> None:
+    """Fails a future, tolerating the caller's concurrent timeout-cancel
+    (``cancel`` can land between a ``cancelled()`` check and the set)."""
+    try:
+        future.set_exception(exc)
+    except InvalidStateError:
+        pass
+
+
+def _resolve(future: Future, result) -> None:
+    try:
+        future.set_result(result)
+    except InvalidStateError:
+        pass
 
 
 class MicroBatcher:
@@ -43,12 +72,16 @@ class MicroBatcher:
 
     def __init__(self, engine: ServingEngine):
         self.engine = engine
+        self.metrics = engine.metrics
         self.max_batch = engine.config.meta_batch_size
         self.max_wait_s = engine.config.max_wait_ms / 1e3
         self._lock = threading.Condition()
         # Insertion-ordered so ties flush oldest-group-first.
         self._groups: "OrderedDict[tuple, _Group]" = OrderedDict()
         self._closed = False
+        self._last_dispatch_at = time.monotonic()
+        # (computed_at, margin_s); stale-by-TTL entries are recomputed.
+        self._margin_cache = (-self.MARGIN_TTL_S, 0.01)
         self._worker = threading.Thread(
             target=self._run, name="serve-batcher", daemon=True
         )
@@ -60,23 +93,73 @@ class MicroBatcher:
 
     def submit(self, episode: EpisodeRequest) -> Future:
         """Enqueues one prepared episode; the Future resolves to its
-        ``(T, num_classes)`` logits (or raises the dispatch error)."""
+        ``(T, num_classes)`` logits (or raises the typed dispatch error).
+        ``episode.deadline`` tightens the group's flush deadline so a
+        short-budget request is never parked for the full batching
+        window."""
         future: Future = Future()
+        # Margin computed OUTSIDE the lock: it sorts latency windows, and
+        # every concurrent submitter would otherwise serialize behind it.
+        margin_s = (
+            self._dispatch_margin_s() if episode.deadline is not None else 0.0
+        )
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            now = time.monotonic()
             group = self._groups.get(episode.bucket)
             if group is None:
-                group = _Group(time.monotonic() + self.max_wait_s)
+                group = _Group(now + self.max_wait_s, now)
                 self._groups[episode.bucket] = group
+            if episode.deadline is not None:
+                # Flush a dispatch-time margin BEFORE the request deadline:
+                # flushing exactly at expiry would guarantee the episode is
+                # dropped by the pre-dispatch deadline check.
+                flush_by = episode.deadline - margin_s
+                group.deadline = min(group.deadline, max(now, flush_by))
             group.episodes.append(episode)
             group.futures.append(future)
             self._lock.notify()
         return future
 
+    #: How long a computed dispatch margin stays fresh. Recomputing per
+    #: request would sort two 2048-sample windows on every submit.
+    MARGIN_TTL_S = 0.5
+
+    def _dispatch_margin_s(self) -> float:
+        """Estimated dispatch cost (observed adapt+classify medians, 10 ms
+        floor before any history) — how far before a request's deadline its
+        group must flush for the answer to still matter. Cached for
+        ``MARGIN_TTL_S``; the tuple swap is atomic and a stale read is
+        harmless (the margin is an estimate either way)."""
+        now = time.monotonic()
+        computed_at, value = self._margin_cache
+        if now - computed_at >= self.MARGIN_TTL_S:
+            margin_ms = (
+                self.metrics.adapt_latency.percentile(50)
+                + self.metrics.classify_latency.percentile(50)
+            )
+            value = max(0.01, margin_ms / 1e3)
+            self._margin_cache = (now, value)
+        return value
+
     def queue_depth(self) -> int:
         with self._lock:
             return sum(len(g.episodes) for g in self._groups.values())
+
+    def oldest_pending_age_s(self) -> float:
+        """Age of the oldest still-queued group (0.0 when idle) — the
+        admission controller's stalled-pipeline signal."""
+        with self._lock:
+            if not self._groups:
+                return 0.0
+            oldest = min(g.created for g in self._groups.values())
+        return max(0.0, time.monotonic() - oldest)
+
+    def last_dispatch_age_s(self) -> float:
+        """Seconds since the worker last completed a dispatch — ``/healthz``
+        wedge telemetry (a large value under load means a stuck worker)."""
+        return max(0.0, time.monotonic() - self._last_dispatch_at)
 
     def close(self, timeout: float = 5.0) -> None:
         """Stops the worker after draining pending groups."""
@@ -121,7 +204,18 @@ class MicroBatcher:
                         self._lock.wait()
                 drained = self._closed and not self._groups
             for group in ready:
-                self._dispatch(group)
+                # The fence: NOTHING a group does may kill the worker —
+                # a dead worker strands every queued Future forever.
+                try:
+                    self._dispatch(group)
+                except Exception as exc:
+                    failure = DispatchFailedError(
+                        f"dispatch worker error: {type(exc).__name__}: {exc}"
+                    )
+                    failure.__cause__ = exc
+                    for future in group.futures:
+                        _fail(future, failure)
+                self._last_dispatch_at = time.monotonic()
             if drained and not ready:
                 return
             if drained and ready:
@@ -130,14 +224,56 @@ class MicroBatcher:
                     if not self._groups:
                         return
 
-    def _dispatch(self, group: _Group) -> None:
-        try:
-            results = self.engine.dispatch(group.episodes)
-        except Exception as exc:  # surface to every caller, keep serving
-            for future in group.futures:
+    def _split_expired(
+        self, group: _Group
+    ) -> tuple[list[EpisodeRequest], list[Future]]:
+        """Fails the futures of already-expired episodes (nobody is waiting
+        — the caller's ``Future.result`` timeout fired) and returns the
+        still-live remainder."""
+        now = time.monotonic()
+        live_eps: list[EpisodeRequest] = []
+        live_futures: list[Future] = []
+        for episode, future in zip(group.episodes, group.futures):
+            if episode.expired(now):
                 if not future.cancelled():
-                    future.set_exception(exc)
+                    # A cancelled future means the CALLER's wait already
+                    # timed out and counted this deadline — don't double.
+                    self.metrics.deadline_exceeded_total.inc()
+                _fail(
+                    future,
+                    DeadlineExceededError(
+                        "request deadline expired in the batcher queue "
+                        "before dispatch"
+                    ),
+                )
+            else:
+                live_eps.append(episode)
+                live_futures.append(future)
+        return live_eps, live_futures
+
+    def _dispatch(self, group: _Group) -> None:
+        episodes, futures = self._split_expired(group)
+        if not episodes:
             return
-        for future, logits in zip(group.futures, results):
-            if not future.cancelled():
-                future.set_result(logits)
+        try:
+            results = self.engine.dispatch(episodes)
+        except Exception as exc:  # surface to every caller, keep serving
+            failure = DispatchFailedError(
+                f"engine dispatch failed: {type(exc).__name__}: {exc}"
+            )
+            failure.__cause__ = exc
+            for future in futures:
+                _fail(future, failure)
+            return
+        if len(results) != len(episodes):
+            for future in futures:
+                _fail(
+                    future,
+                    DispatchFailedError(
+                        f"engine returned {len(results)} results for "
+                        f"{len(episodes)} episodes"
+                    ),
+                )
+            return
+        for future, logits in zip(futures, results):
+            _resolve(future, logits)

@@ -35,7 +35,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Any, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -43,10 +43,22 @@ import numpy as np
 
 from ..models.common import encode_images
 from ..telemetry import events as telemetry_events
+from ..utils import faultinject
 from .cache import AdaptedParamsCache, support_digest
+from .errors import SwapRejectedError
 from .metrics import ServeMetrics
 
 Tree = Any
+
+
+class _Published(NamedTuple):
+    """The served checkpoint, published as ONE immutable object so readers
+    can never observe a version number from one swap and parameters from
+    another (attribute rebinding is atomic under the GIL; two separate
+    fields would not be)."""
+
+    version: int
+    istate: Any
 
 #: learner class name -> the short family name used in program names,
 #: cache digests, and metric labels.
@@ -70,6 +82,20 @@ class ServeConfig:
     max_wait_ms: float = 2.0
     #: Adapted-params cache capacity, in episodes. 0 disables caching.
     cache_capacity: int = 256
+    #: Admission control (serve/resilience/admission.py). Hard limit: at or
+    #: above this many queued episodes every request is shed with 503 +
+    #: Retry-After — bounded queues are what keep p99 finite under overload.
+    max_queue_depth: int = 64
+    #: Soft limit: at or above this depth the server is DEGRADED — cold
+    #: (cache-miss, inner-loop-paying) traffic is shed first while cache-hit
+    #: classify traffic keeps flowing (graceful degradation: the cheap tier
+    #: stays alive). <= 0 disables the degraded tier.
+    degrade_queue_depth: int = 16
+    #: Oldest-queued-request age that flips the server to degraded even at
+    #: low depth (a stalled dispatch pipeline, not an arrival burst).
+    max_queue_age_ms: float = 2_000.0
+    #: ``Retry-After`` seconds returned with shed (503) responses.
+    retry_after_s: float = 1.0
 
     def __post_init__(self):
         if self.meta_batch_size < 1:
@@ -79,6 +105,10 @@ class ServeConfig:
         if self.max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}"
             )
 
 
@@ -92,10 +122,21 @@ class EpisodeRequest:
     way: int
     shot: int
     digest: str
+    #: Absolute ``time.monotonic()`` deadline propagated from the front
+    #: door through batcher and engine; ``None`` = no budget. The batcher
+    #: flushes a group early to honor the tightest member deadline and
+    #: DROPS episodes already past it before dispatch (work nobody is
+    #: waiting for must not occupy the device).
+    deadline: float | None = None
 
     @property
     def bucket(self) -> tuple[int, int, int]:
         return (self.way, self.shot, int(self.x_query.shape[0]))
+
+    def expired(self, now: float | None = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
 
 
 class ServingEngine:
@@ -115,10 +156,17 @@ class ServingEngine:
             type(learner).__name__, type(learner).__name__.lower()
         )
         self.cache = AdaptedParamsCache(self.config.cache_capacity)
-        self.state_version = 0
-        self._istate = learner.inference_state(state)
+        self._published = _Published(0, learner.inference_state(state))
         self._compiles: dict[str, int] = {}
         self._compiles_lock = threading.Lock()
+        self._warmed_lock = threading.Lock()
+        #: Buckets this engine has compiled programs for (warmup + traffic)
+        #: — the canary set a hot-swap must prove finite before publishing.
+        self._warmed_buckets: set[tuple[int, int, int]] = set()
+        #: Readiness: warmup completed, or at least one dispatch answered.
+        #: ``/healthz`` reports 503 until this flips — a replica that has
+        #: never produced logits must not attract traffic.
+        self.ready = False
         self._adapt, self._classify = self._build_programs()
 
     # ------------------------------------------------------------------
@@ -171,15 +219,35 @@ class ServingEngine:
     # State management
     # ------------------------------------------------------------------
 
+    @property
+    def state_version(self) -> int:
+        return self._published.version
+
     def update_state(self, state) -> int:
-        """Hot-swaps the served checkpoint. Bumping ``state_version``
-        invalidates every cached adapted artifact WITHOUT racing in-flight
-        requests — new digests embed the new version, old entries age out
-        of the LRU. Returns the new version."""
-        self._istate = self.learner.inference_state(state)
-        self.state_version += 1
+        """Hot-swaps the served checkpoint — the RAW publish primitive: no
+        verification, no canary (``serve/resilience/swap.py`` wraps it with
+        both; ``ServingAPI.promote`` is the safe entry point). The new
+        ``(version, istate)`` pair is published as one atomic object, so a
+        concurrent dispatch snapshots either the old state or the new one,
+        never a mixture. Bumping the version invalidates every cached
+        adapted artifact WITHOUT racing in-flight requests — new digests
+        embed the new version, old entries age out of the LRU. Returns the
+        new version."""
+        old = self._published
+        self._published = _Published(
+            old.version + 1, self.learner.inference_state(state)
+        )
         self.cache.clear()
-        return self.state_version
+        return self._published.version
+
+    def warmed_buckets(self) -> list[tuple[int, int, int]]:
+        """Buckets with compiled programs (warmup + observed traffic)."""
+        with self._warmed_lock:
+            return sorted(self._warmed_buckets)
+
+    def _note_bucket(self, bucket: tuple[int, int, int]) -> None:
+        with self._warmed_lock:
+            self._warmed_buckets.add(bucket)
 
     # ------------------------------------------------------------------
     # Request preparation
@@ -287,10 +355,10 @@ class ServingEngine:
 
     def _dispatch_chunk(self, eps: Sequence[EpisodeRequest]) -> list[np.ndarray]:
         b = self.config.meta_batch_size
-        # One state snapshot for BOTH stages: a concurrent update_state must
-        # never split a dispatch across checkpoint versions (new frozen
-        # params classifying old fast weights).
-        istate = self._istate
+        # One published-state snapshot for BOTH stages: a concurrent
+        # update_state must never split a dispatch across checkpoint
+        # versions (new frozen params classifying old fast weights).
+        istate = self._published.istate
         self.metrics.batches_dispatched.inc()
         self.metrics.padded_tasks.inc(b - len(eps))
         self.metrics.record_bucket_dispatch(eps[0].bucket, len(eps))
@@ -332,8 +400,10 @@ class ServingEngine:
         logits = jax.block_until_ready(logits)
         classify_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.classify_latency.observe(classify_ms)
-        host = np.asarray(logits)
+        host = faultinject.poison_logits(np.asarray(logits))
         self.metrics.episodes_served.inc(len(eps))
+        self._note_bucket(eps[0].bucket)
+        self.ready = True
         telemetry_events.emit(
             "serve_dispatch",
             bucket="x".join(str(d) for d in eps[0].bucket),
@@ -348,22 +418,70 @@ class ServingEngine:
     # Warmup
     # ------------------------------------------------------------------
 
+    def _synthetic_episode(
+        self, way: int, shot: int, query: int
+    ) -> EpisodeRequest:
+        """A deterministic non-degenerate episode at the given bucket —
+        shared by warmup (compile probe) and hot-swap canaries (numeric
+        probe: all-zero images would let a NaN-in-bias checkpoint slip
+        through a ReLU net, so the canary feeds structured non-zero data)."""
+        bb = self.learner.cfg.backbone
+        way = min(int(way), bb.num_classes)
+        img = (bb.image_channels, bb.image_height, bb.image_width)
+        xs = np.linspace(0.0, 1.0, num=int(np.prod((way * shot,) + img)))
+        xs = xs.reshape((way * shot,) + img).astype(np.float32)
+        ys = np.asarray([c for c in range(way) for _ in range(shot)], np.int32)
+        xq = np.linspace(1.0, 0.0, num=int(np.prod((query,) + img)))
+        xq = xq.reshape((query,) + img).astype(np.float32)
+        return self.prepare_episode(xs, ys, xq)
+
     def warmup(self, buckets: Sequence[tuple[int, int, int]]) -> None:
         """Pre-compiles the program pair for each declared ``(way, shot,
         query)`` bucket so first-request latency is a dispatch, not an XLA
-        compile. Bypasses the cache (zero-image warmup episodes must not
-        occupy capacity or answer a real all-zero request)."""
-        bb = self.learner.cfg.backbone
+        compile, and marks the engine ready. Bypasses the cache (synthetic
+        warmup episodes must not occupy capacity or answer a real
+        request)."""
+        istate = self._published.istate
         for way, shot, query in buckets:
-            way = min(int(way), bb.num_classes)
-            img = (bb.image_channels, bb.image_height, bb.image_width)
-            xs = np.zeros((way * shot,) + img, np.float32)
-            ys = np.asarray(
-                [c for c in range(way) for _ in range(shot)], np.int32
-            )
-            xq = np.zeros((query,) + img, np.float32)
-            ep = self.prepare_episode(xs, ys, xq)
+            ep = self._synthetic_episode(way, shot, query)
             xs_b = self._pad_rows([ep.x_support])
             ys_b = self._pad_rows([ep.y_support])
-            adapted = self._adapt(self._istate, xs_b, ys_b)
-            self._classify(self._istate, adapted, self._pad_rows([ep.x_query]))
+            adapted = self._adapt(istate, xs_b, ys_b)
+            self._classify(istate, adapted, self._pad_rows([ep.x_query]))
+            self._note_bucket(ep.bucket)
+        self.ready = True
+
+    # ------------------------------------------------------------------
+    # Hot-swap canary
+    # ------------------------------------------------------------------
+
+    def canary_probe(
+        self, istate, buckets: Sequence[tuple[int, int, int]] | None = None
+    ) -> list[tuple[int, int, int]]:
+        """Runs one synthetic episode per bucket against a CANDIDATE state
+        (not the published one) and verifies every logit is finite — the
+        pre-publish gate of a safe hot-swap (``serve/resilience/swap.py``).
+
+        Rides the already-compiled program pair: the candidate istate has
+        the published state's shapes/dtypes, so canaries mint no new
+        program signatures. Bypasses cache and episode counters (a canary
+        is not traffic). Raises ``SwapRejectedError`` naming the failing
+        bucket; returns the list of buckets probed."""
+        probed = buckets if buckets is not None else self.warmed_buckets()
+        for bucket in probed:
+            way, shot, query = bucket
+            ep = self._synthetic_episode(way, shot, query)
+            xs_b = self._pad_rows([ep.x_support])
+            ys_b = self._pad_rows([ep.y_support])
+            adapted = self._adapt(istate, xs_b, ys_b)
+            logits = self._classify(istate, adapted, self._pad_rows([ep.x_query]))
+            host = faultinject.poison_logits(
+                np.asarray(jax.block_until_ready(logits))
+            )
+            if not np.isfinite(host).all():
+                raise SwapRejectedError(
+                    f"canary episode at bucket {way}x{shot}x{query} produced "
+                    "non-finite logits — refusing to promote this state",
+                    reason="nonfinite_logits",
+                )
+        return list(probed)

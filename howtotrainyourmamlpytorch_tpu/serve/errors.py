@@ -1,0 +1,69 @@
+"""Typed serving-failure surface.
+
+Every failure mode the resilience layer (`serve/resilience`, `serve/pool`)
+recovers from — or deliberately surfaces — gets its own exception class, so
+callers branch on TYPE, never on message text:
+
+* the HTTP frontend maps ``OverloadedError`` to 503 + ``Retry-After`` and
+  ``DeadlineExceededError`` to 503, without string matching;
+* the replica pool retries ``ReplicaDeadError`` (pure serve functions make
+  re-dispatch idempotent) but NEVER retries ``OverloadedError`` from its own
+  admission layer — retrying a shed would amplify the overload it exists to
+  relieve;
+* ``DeadlineExceededError`` subclasses builtin ``TimeoutError`` so existing
+  embedders that catch ``TimeoutError`` (the pre-resilience API contract)
+  keep working unchanged.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(Exception):
+    """Base class for typed serving-runtime failures."""
+
+
+class OverloadedError(ServeError):
+    """The request was shed by admission control (queue depth/age past the
+    configured limits). Clients should back off ``retry_after_s`` — the HTTP
+    frontend surfaces it as 503 + ``Retry-After``."""
+
+    def __init__(self, message: str, *, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class NoHealthyReplicaError(OverloadedError):
+    """The replica pool has no healthy replica to dispatch to (all crashed,
+    wedged, or circuit-open). A retryable outage, not a client error."""
+
+
+class DeadlineExceededError(ServeError, TimeoutError):
+    """The request's deadline budget ran out — in the caller's wait, or in
+    the batcher queue before dispatch (the work is dropped, not run: nobody
+    is waiting for the answer). Subclasses ``TimeoutError`` to preserve the
+    pre-resilience API contract."""
+
+
+class DispatchFailedError(ServeError):
+    """The batcher worker's engine dispatch failed for this request's group.
+
+    The worker thread survives (it fences every group — a poisoned episode
+    must never strand the queued Futures of every OTHER request), fails the
+    affected group with this error, and keeps serving. The original engine
+    exception rides along as ``__cause__``."""
+
+
+class ReplicaDeadError(ServeError):
+    """A pool replica crashed or refused the dispatch at the process level
+    (connection refused/reset, process exited). The pool marks the replica
+    for supervision and re-dispatches the request to a healthy one."""
+
+
+class SwapRejectedError(ServeError):
+    """A checkpoint promotion failed verification (corrupt manifest, failed
+    canary episode, non-finite canary logits). The previous state is still
+    serving — promotion never publishes before the canary passes."""
+
+    def __init__(self, message: str, *, reason: str = "canary"):
+        super().__init__(message)
+        self.reason = reason

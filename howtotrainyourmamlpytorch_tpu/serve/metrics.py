@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import threading
 
-from ..telemetry.registry import Counter, LatencyStat
+from ..telemetry.registry import Counter, Gauge, LatencyStat
 
-__all__ = ["Counter", "LatencyStat", "ServeMetrics"]
+__all__ = ["Counter", "Gauge", "LatencyStat", "ServeMetrics"]
 
 
 class ServeMetrics:
@@ -43,6 +43,13 @@ class ServeMetrics:
         self.cache_misses = Counter("cache_misses")
         self.batches_dispatched = Counter("batches_dispatched")
         self.padded_tasks = Counter("padded_tasks")
+        # Resilience layer (serve/resilience, serve/pool): admission sheds,
+        # queue-expired deadlines, and the hot-swap promotion verdicts.
+        self.shed_total = Counter("shed_total")
+        self.deadline_exceeded_total = Counter("deadline_exceeded_total")
+        self.swaps_total = Counter("swaps_total")
+        self.swap_rejected_total = Counter("swap_rejected_total")
+        self.degraded = Gauge("degraded")
         # bucket key -> {"dispatches": int, "episodes": int}; compile counts
         # live with the engine (it owns the jit boundary) and are merged
         # into snapshots by the caller.
@@ -75,6 +82,11 @@ class ServeMetrics:
             "episodes_served": self.episodes_served.value,
             "batches_dispatched": self.batches_dispatched.value,
             "padded_tasks": self.padded_tasks.value,
+            "shed_total": self.shed_total.value,
+            "deadline_exceeded_total": self.deadline_exceeded_total.value,
+            "swaps_total": self.swaps_total.value,
+            "swap_rejected_total": self.swap_rejected_total.value,
+            "degraded": bool(self.degraded.value),
             "queue_depth": queue_depth,
             "cache": {
                 "hits": self.cache_hits.value,
@@ -108,6 +120,16 @@ class ServeMetrics:
             f"{p}_batches_dispatched_total {self.batches_dispatched.value}",
             f"# TYPE {p}_padded_tasks_total counter",
             f"{p}_padded_tasks_total {self.padded_tasks.value}",
+            f"# TYPE {p}_shed_total counter",
+            f"{p}_shed_total {self.shed_total.value}",
+            f"# TYPE {p}_deadline_exceeded_total counter",
+            f"{p}_deadline_exceeded_total {self.deadline_exceeded_total.value}",
+            f"# TYPE {p}_swaps_total counter",
+            f"{p}_swaps_total {self.swaps_total.value}",
+            f"# TYPE {p}_swap_rejected_total counter",
+            f"{p}_swap_rejected_total {self.swap_rejected_total.value}",
+            f"# TYPE {p}_degraded gauge",
+            f"{p}_degraded {int(self.degraded.value)}",
             f"# TYPE {p}_queue_depth gauge",
             f"{p}_queue_depth {queue_depth}",
             f"# TYPE {p}_cache_hits_total counter",

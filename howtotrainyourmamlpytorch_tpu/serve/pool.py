@@ -1,0 +1,546 @@
+"""Replica pool: N serving engines behind one front door, supervised.
+
+A single serving process is a single point of failure AND a single head-of-
+line: one cold adapt at a new shape bucket stalls every co-batched request
+behind a compile, and one crash strands every queued future. The pool runs
+``n_replicas`` workers (``serve/resilience/replica.py`` flavors: worker
+subprocesses in production, in-process replicas in tests and on small
+hosts), each with its own engine, and owns three jobs:
+
+* **dispatch with re-dispatch** — requests round-robin over healthy
+  replicas; a ``ReplicaDeadError`` (crashed process, dropped connection,
+  wedged worker) retires that replica and re-sends the request to another,
+  up to ``max_dispatch_retries`` times. ``serve_adapt``/``serve_classify``
+  are pure, so the retry is idempotent — the caller sees one answer,
+  bit-exact, and ZERO failed requests across a replica death.
+* **supervision** — a background thread health-checks every replica on
+  ``health_interval_s`` via its ``/healthz`` surface (with a timeout, so a
+  WEDGED replica that still holds its TCP port is detected, not just a
+  dead one). ``unhealthy_after`` consecutive failures retire the replica;
+  retired slots restart with exponential backoff, and a slot that keeps
+  dying young trips a crash-loop circuit breaker (``circuit_breaker_after``)
+  and is parked instead of burning the host on futile restarts.
+* **front-door surface** — the pool quacks like ``ServingAPI`` (classify /
+  healthz / stats / metrics_text / promote / close), so the stdlib HTTP
+  frontend (``serve/api.make_http_server``) binds it unchanged, and
+  ``/healthz`` aggregates per-replica state with an honest ``degraded``
+  flag.
+
+Checkpoint promotion is canary-first: the file is manifest-verified once
+at the front door (``utils/checkpoint.verify_checkpoint`` — a corrupt file
+never costs a replica), then replica 0 canaries it (``serve/resilience/
+swap.py``), and only on acceptance does it roll to the rest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from ..telemetry import events as telemetry_events
+from ..utils.checkpoint import CheckpointError, verify_checkpoint
+from .errors import (
+    NoHealthyReplicaError,
+    ReplicaDeadError,
+    SwapRejectedError,
+)
+from .metrics import Counter, LatencyStat
+from .resilience.replica import Replica
+
+#: Slot lifecycle: STARTING -(ready healthz)-> HEALTHY -(strikes/death)->
+#: RETIRED -(backoff)-> STARTING ... -(crash loop)-> CIRCUIT_OPEN.
+STARTING = "starting"
+HEALTHY = "healthy"
+RETIRED = "retired"
+CIRCUIT_OPEN = "circuit_open"
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolConfig:
+    """Supervision and re-dispatch knobs (CLI: ``tools/serve_maml.py``)."""
+
+    n_replicas: int = 2
+    #: Supervisor cadence and per-probe budget. A wedged replica is detected
+    #: within ``unhealthy_after * health_interval_s + health_timeout_s``.
+    health_interval_s: float = 0.25
+    health_timeout_s: float = 2.0
+    unhealthy_after: int = 2
+    #: Restart backoff: ``restart_backoff_s * 2**consecutive_failures``,
+    #: capped. A replica must stay healthy ``min_uptime_s`` to reset the
+    #: failure streak (instant-death restarts must not reset the clock).
+    restart_backoff_s: float = 0.2
+    restart_backoff_max_s: float = 30.0
+    min_uptime_s: float = 5.0
+    #: Consecutive young deaths that park the slot (crash-loop breaker).
+    circuit_breaker_after: int = 5
+    #: Re-dispatch budget after a replica dies mid-request.
+    max_dispatch_retries: int = 2
+    #: Per-attempt replica call budget (bounds how long a silently-wedged
+    #: replica can hold a caller before the retry fires).
+    dispatch_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {self.n_replicas}")
+        if self.unhealthy_after < 1:
+            raise ValueError(
+                f"unhealthy_after must be >= 1, got {self.unhealthy_after}"
+            )
+
+
+class _Slot:
+    """One supervised replica position."""
+
+    __slots__ = (
+        "index", "replica", "state", "strikes", "consecutive_failures",
+        "restarts", "next_restart_at", "healthy_since",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.replica: Replica | None = None
+        self.state = RETIRED
+        self.strikes = 0
+        self.consecutive_failures = 0
+        self.restarts = 0
+        self.next_restart_at = 0.0
+        self.healthy_since: float | None = None
+
+    def describe(self) -> dict:
+        return {
+            "index": self.index,
+            "id": self.replica.replica_id if self.replica else None,
+            "state": self.state,
+            "strikes": self.strikes,
+            "restarts": self.restarts,
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+class PoolMetrics:
+    """Pool-level counters (replica engines keep their own
+    ``ServeMetrics``; these count what only the pool can see)."""
+
+    PREFIX = "maml_serve_pool"
+
+    def __init__(self):
+        self.requests_total = Counter("requests_total")
+        self.request_errors = Counter("request_errors")
+        self.retry_total = Counter("retry_total")
+        self.shed_total = Counter("shed_total")
+        self.replica_deaths_total = Counter("replica_deaths_total")
+        self.replica_restarts_total = Counter("replica_restarts_total")
+        self.circuit_open_total = Counter("circuit_open_total")
+        self.request_latency = LatencyStat("request")
+
+
+class ReplicaPool:
+    """Supervised replica fleet with a ``ServingAPI``-shaped front door."""
+
+    #: The HTTP frontend checks this to route per-replica fault hooks to
+    #: worker processes instead of the front door (serve/api.py).
+    is_replica_pool = True
+
+    def __init__(self, factory, config: PoolConfig | None = None):
+        """``factory(slot_index) -> Replica`` builds (and starts) one
+        replica; it is called from the supervisor thread on every restart,
+        so it must be safe to call repeatedly."""
+        self.factory = factory
+        self.config = config or PoolConfig()
+        self.metrics = PoolMetrics()
+        self.started_at = time.time()
+        self._lock = threading.Condition()
+        self._slots = [_Slot(i) for i in range(self.config.n_replicas)]
+        self._rr = 0  # round-robin cursor
+        self._graveyard: list[Replica] = []  # terminated by the supervisor
+        self._closed = False
+        for slot in self._slots:
+            self._try_start(slot)
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="replica-pool-supervisor", daemon=True
+        )
+        self._supervisor.start()
+
+    # ------------------------------------------------------------------
+    # Dispatch (front door)
+    # ------------------------------------------------------------------
+
+    def _pick(self) -> tuple[_Slot, Replica] | None:
+        """Next healthy (slot, replica) pair, round-robin; ``None`` when
+        the fleet is out. The replica is captured under the lock so a
+        concurrent retirement can never hand the caller a ``None``."""
+        with self._lock:
+            healthy = [
+                s for s in self._slots
+                if s.state == HEALTHY and s.replica is not None
+            ]
+            if not healthy:
+                return None
+            slot = healthy[self._rr % len(healthy)]
+            self._rr += 1
+            return slot, slot.replica
+
+    def classify(
+        self, x_support, y_support, x_query, *, timeout: float | None = 30.0
+    ) -> dict:
+        """Dispatches one episode to a healthy replica, re-dispatching on
+        replica death (bounded by ``max_dispatch_retries``). Raises
+        ``NoHealthyReplicaError`` (a 503) when the fleet cannot answer;
+        replica-level sheds (``OverloadedError``) and validation errors
+        propagate unchanged — retrying them elsewhere would amplify
+        overload / re-reject the same episode."""
+        self.metrics.requests_total.inc()
+        t0 = time.perf_counter()
+        budget = (
+            None if timeout is None else time.monotonic() + float(timeout)
+        )
+        attempts = self.config.max_dispatch_retries + 1
+        last_death: ReplicaDeadError | None = None
+        try:
+            for attempt in range(attempts):
+                picked = self._pick()
+                if picked is None:
+                    raise NoHealthyReplicaError(
+                        "no healthy replica available "
+                        f"({self._state_counts()})"
+                    )
+                slot, replica = picked
+                per_attempt = self.config.dispatch_timeout_s
+                if budget is not None:
+                    remaining = budget - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "pool dispatch exceeded the caller deadline"
+                        )
+                    per_attempt = min(per_attempt, remaining)
+                try:
+                    return replica.classify(
+                        x_support, y_support, x_query, timeout=per_attempt
+                    )
+                except ReplicaDeadError as exc:
+                    last_death = exc
+                    self._report_death(slot, replica)
+                    if attempt < attempts - 1:
+                        self.metrics.retry_total.inc()
+            raise NoHealthyReplicaError(
+                f"request re-dispatched {attempts} times, every replica "
+                f"died under it (last: {last_death})"
+            )
+        except NoHealthyReplicaError:
+            self.metrics.shed_total.inc()
+            self.metrics.request_errors.inc()
+            raise
+        except Exception:
+            self.metrics.request_errors.inc()
+            raise
+        finally:
+            self.metrics.request_latency.observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+
+    def _report_death(self, slot: _Slot, replica: Replica) -> None:
+        """Fast-path retirement from the dispatch side: a dropped
+        connection is stronger evidence than a missed health probe."""
+        with self._lock:
+            if slot.replica is not replica or slot.state in (
+                RETIRED, CIRCUIT_OPEN,
+            ):
+                return  # supervisor already handled it
+            self._retire_locked(slot, why="dispatch failure")
+            self._lock.notify()
+
+    # ------------------------------------------------------------------
+    # Supervision
+    # ------------------------------------------------------------------
+
+    def _retire_locked(self, slot: _Slot, why: str) -> None:
+        replica = slot.replica
+        if replica is not None:
+            self._graveyard.append(replica)
+        # Young death (never healthy, or healthy for less than min_uptime)
+        # extends the crash streak; a replica that proved itself by serving
+        # a while resets it. One that NEVER became healthy (factory failure,
+        # died while starting) always extends — that's the crash loop the
+        # breaker exists for.
+        now = time.monotonic()
+        if (
+            slot.healthy_since is not None
+            and now - slot.healthy_since >= self.config.min_uptime_s
+        ):
+            slot.consecutive_failures = 0
+        slot.consecutive_failures += 1
+        slot.replica = None
+        slot.healthy_since = None
+        slot.strikes = 0
+        self.metrics.replica_deaths_total.inc()
+        telemetry_events.emit(
+            "replica_dead",
+            slot=slot.index,
+            why=why,
+            consecutive_failures=slot.consecutive_failures,
+        )
+        if slot.consecutive_failures >= self.config.circuit_breaker_after:
+            slot.state = CIRCUIT_OPEN
+            self.metrics.circuit_open_total.inc()
+            telemetry_events.emit("replica_circuit_open", slot=slot.index)
+            return
+        slot.state = RETIRED
+        backoff = min(
+            self.config.restart_backoff_s
+            * (2 ** (slot.consecutive_failures - 1)),
+            self.config.restart_backoff_max_s,
+        )
+        slot.next_restart_at = now + backoff
+
+    def _try_start(self, slot: _Slot) -> None:
+        """Builds a replica for ``slot`` (factory may block; called at
+        construction and from the supervisor thread)."""
+        try:
+            replica = self.factory(slot.index)
+        except Exception as exc:
+            with self._lock:
+                slot.replica = None
+                self._retire_locked(slot, why=f"factory failed: {exc}")
+            return
+        with self._lock:
+            adopted = not self._closed
+            if adopted:
+                slot.replica = replica
+                slot.state = STARTING
+                slot.strikes = 0
+                slot.restarts += 1
+                is_restart = slot.restarts > 1
+        if not adopted:
+            # Shutdown raced the start: nobody will supervise it — stop it
+            # here instead of leaking a live replica.
+            try:
+                replica.terminate()
+            except Exception:
+                pass
+            return
+        if is_restart:  # the initial boot of a slot is not a "restart"
+            self.metrics.replica_restarts_total.inc()
+            telemetry_events.emit(
+                "replica_restart", slot=slot.index, restarts=slot.restarts - 1
+            )
+
+    def _probe(self, slot: _Slot) -> None:
+        replica = slot.replica
+        if replica is None:
+            return
+        try:
+            health = replica.healthz(timeout=self.config.health_timeout_s)
+        except Exception as exc:  # dead, wedged (timeout), or transport
+            with self._lock:
+                if slot.replica is not replica:
+                    return
+                slot.strikes += 1
+                if slot.strikes >= self.config.unhealthy_after:
+                    self._retire_locked(slot, why=f"health: {exc}")
+            return
+        with self._lock:
+            if slot.replica is not replica:
+                return
+            slot.strikes = 0
+            if health.get("ready", True):
+                if slot.state != HEALTHY:
+                    slot.state = HEALTHY
+                    slot.healthy_since = time.monotonic()
+                    telemetry_events.emit(
+                        "replica_healthy", slot=slot.index,
+                        restarts=slot.restarts,
+                    )
+            else:
+                slot.state = STARTING  # alive, still warming
+
+    def _supervise(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                graveyard, self._graveyard = self._graveyard, []
+                due = [
+                    s for s in self._slots
+                    if s.state == RETIRED
+                    and time.monotonic() >= s.next_restart_at
+                ]
+                probes = [
+                    s for s in self._slots
+                    if s.state in (STARTING, HEALTHY) and s.replica is not None
+                ]
+            for replica in graveyard:
+                try:
+                    replica.terminate()
+                except Exception:
+                    pass  # already gone — termination is best-effort
+            for slot in due:
+                self._try_start(slot)
+            for slot in probes:
+                self._probe(slot)
+            with self._lock:
+                if self._closed:
+                    return
+                self._lock.wait(self.config.health_interval_s)
+
+    # ------------------------------------------------------------------
+    # Operational surface (ServingAPI-shaped)
+    # ------------------------------------------------------------------
+
+    def _state_counts(self) -> dict:
+        with self._lock:
+            counts: dict[str, int] = {}
+            for slot in self._slots:
+                counts[slot.state] = counts.get(slot.state, 0) + 1
+            return counts
+
+    def healthz(self) -> dict:
+        with self._lock:
+            replicas = [slot.describe() for slot in self._slots]
+        healthy = sum(1 for r in replicas if r["state"] == HEALTHY)
+        size = len(replicas)
+        degraded = healthy < size
+        ready = healthy > 0
+        return {
+            "status": (
+                "ok" if not degraded else ("degraded" if ready else "unready")
+            ),
+            "ready": ready,
+            "degraded": degraded,
+            "replicas": replicas,
+            "healthy_replicas": healthy,
+            "pool_size": size,
+            "uptime_s": time.time() - self.started_at,
+        }
+
+    def wait_ready(
+        self, timeout: float = 120.0, *, healthy: int | None = None
+    ) -> bool:
+        """Blocks until ``healthy`` replicas (default: all) pass health
+        checks; returns False on timeout."""
+        want = self.config.n_replicas if healthy is None else healthy
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.healthz()["healthy_replicas"] >= want:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def promote(self, checkpoint_path: str) -> dict:
+        """Rolls a checkpoint across the fleet, canary-first: manifest
+        verification happens ONCE at the front door (a corrupt file costs
+        zero replicas), then replica 0 must accept (canary episodes against
+        the candidate state) before the rest are touched. Raises
+        ``SwapRejectedError`` on the front-door verify or the first replica
+        rejection; the message counts replicas already promoted so a
+        mid-roll divergence is visible to the operator."""
+        try:
+            verify_checkpoint(checkpoint_path)
+        except CheckpointError as exc:
+            telemetry_events.emit(
+                "swap_rejected",
+                source=checkpoint_path,
+                reason="corrupt_checkpoint",
+                detail=str(exc),
+            )
+            raise SwapRejectedError(
+                f"checkpoint failed front-door verification: {exc}",
+                reason="corrupt_checkpoint",
+            ) from exc
+        with self._lock:
+            targets = [
+                s.replica for s in self._slots
+                if s.state == HEALTHY and s.replica is not None
+            ]
+        if not targets:
+            raise NoHealthyReplicaError("no healthy replica to promote onto")
+        promoted = 0
+        for replica in targets:
+            try:
+                result = replica.promote(checkpoint_path)
+            except SwapRejectedError as exc:
+                raise SwapRejectedError(
+                    f"replica {replica.replica_id} rejected the swap after "
+                    f"{promoted}/{len(targets)} replicas promoted: {exc}",
+                    reason=exc.reason,
+                ) from exc
+            promoted += 1
+        telemetry_events.emit(
+            "pool_swap_promoted", source=checkpoint_path, replicas=promoted,
+        )
+        return {
+            "promoted_replicas": promoted,
+            "state_version": result.get("state_version"),
+        }
+
+    def stats(self) -> dict:
+        m = self.metrics
+        return {
+            "requests_total": m.requests_total.value,
+            "request_errors": m.request_errors.value,
+            "retry_total": m.retry_total.value,
+            "shed_total": m.shed_total.value,
+            "replica_deaths_total": m.replica_deaths_total.value,
+            "replica_restarts_total": m.replica_restarts_total.value,
+            "circuit_open_total": m.circuit_open_total.value,
+            "latency_ms": {"request": m.request_latency.snapshot()},
+            "replicas": self.healthz()["replicas"],
+        }
+
+    def metrics_text(self) -> str:
+        p = self.metrics.PREFIX
+        m = self.metrics
+        health = self.healthz()
+        lines = [
+            f"# TYPE {p}_requests_total counter",
+            f"{p}_requests_total {m.requests_total.value}",
+            f"# TYPE {p}_request_errors_total counter",
+            f"{p}_request_errors_total {m.request_errors.value}",
+            f"# TYPE {p}_retry_total counter",
+            f"{p}_retry_total {m.retry_total.value}",
+            f"# TYPE {p}_shed_total counter",
+            f"{p}_shed_total {m.shed_total.value}",
+            f"# TYPE {p}_replica_deaths_total counter",
+            f"{p}_replica_deaths_total {m.replica_deaths_total.value}",
+            f"# TYPE {p}_replica_restarts_total counter",
+            f"{p}_replica_restarts_total {m.replica_restarts_total.value}",
+            f"# TYPE {p}_circuit_open_total counter",
+            f"{p}_circuit_open_total {m.circuit_open_total.value}",
+            f"# TYPE {p}_healthy_replicas gauge",
+            f"{p}_healthy_replicas {health['healthy_replicas']}",
+            f"# TYPE {p}_degraded gauge",
+            f"{p}_degraded {int(health['degraded'])}",
+        ]
+        snap = m.request_latency.snapshot()
+        lines += [
+            f"# TYPE {p}_request_latency_ms summary",
+            f'{p}_request_latency_ms{{quantile="0.5"}} {snap["p50_ms"]:.6f}',
+            f'{p}_request_latency_ms{{quantile="0.99"}} {snap["p99_ms"]:.6f}',
+            f"{p}_request_latency_ms_count {snap['count']}",
+            f"{p}_request_latency_ms_sum {snap['sum_ms']:.6f}",
+        ]
+        for slot in health["replicas"]:
+            lines.append(
+                f'{p}_replica_up{{slot="{slot["index"]}"}} '
+                f"{int(slot['state'] == HEALTHY)}"
+            )
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            replicas = [s.replica for s in self._slots if s.replica]
+            replicas += self._graveyard
+            self._graveyard = []
+            for slot in self._slots:
+                slot.replica = None
+                slot.state = RETIRED
+            self._lock.notify_all()
+        self._supervisor.join(timeout=10)
+        for replica in replicas:
+            try:
+                replica.terminate()
+            except Exception:
+                pass  # best-effort shutdown

@@ -1,0 +1,47 @@
+"""Serving fault-tolerance layer: the request-path mirror of PR 3's
+training pillars.
+
+Training got checksummed checkpoints, preemption-safe shutdown, and a
+divergence sentinel; the serving runtime gets the equivalent four:
+
+* ``admission`` — bounded queues + deadline budgets: shed with 503 +
+  ``Retry-After`` before the queue melts, cold-adapt traffic first
+  (graceful degradation keeps the cache-hit classify tier alive).
+* ``swap``      — safe hot-swap: checkpoint promotion verifies the
+  integrity manifest, canaries every warmed bucket against the CANDIDATE
+  state, checks logits finite, and only then publishes. A bad checkpoint
+  never serves a single request.
+* ``replica``   — the replica abstraction the pool supervises:
+  ``LocalReplica`` (in-process, deterministic tier-1 fault tests under the
+  compile guard), ``HttpReplica`` / ``SubprocessReplica`` (the production
+  one-process-per-engine shape).
+* ``serve/pool.py`` — N replicas behind one front door: health-checked,
+  crash-restarted with exponential backoff and a crash-loop circuit
+  breaker, with in-flight requests re-dispatched to healthy replicas
+  (``serve_adapt``/``serve_classify`` are pure, so retry is idempotent).
+
+Every recovery path is proven by deterministic fault injection
+(``utils/faultinject.py``: ``replica_kill_at_request``,
+``wedge_replica_at_request``, ``corrupt_swap_at``, ``nan_next_logits``) in
+tier-1, and measured by ``tools/serve_loadtest.py``.
+"""
+
+from .admission import AdmissionController
+from .replica import (
+    HttpReplica,
+    LocalReplica,
+    Replica,
+    SubprocessReplica,
+)
+from .swap import SwapResult, promote_checkpoint, promote_state
+
+__all__ = [
+    "AdmissionController",
+    "Replica",
+    "LocalReplica",
+    "HttpReplica",
+    "SubprocessReplica",
+    "SwapResult",
+    "promote_checkpoint",
+    "promote_state",
+]

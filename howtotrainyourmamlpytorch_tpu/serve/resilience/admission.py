@@ -1,0 +1,73 @@
+"""Admission control: bounded queues, graceful degradation, early 503s.
+
+An overloaded few-shot server fails in a specific, ugly way without this:
+every queued episode holds its caller's thread (the API is synchronous),
+queue time compounds into everyone's latency, and by the time requests
+start timing out the queue holds seconds of work nobody is waiting for
+anymore. The fix is the classic one — REFUSE work at the front door while
+refusal is still cheap:
+
+* **hard limit** (``max_queue_depth``): at or above this many queued
+  episodes, every request is shed with ``OverloadedError`` (HTTP 503 +
+  ``Retry-After``). The queue is bounded, so p99 under overload is the
+  dispatch pipeline's, not the arrival process's.
+* **degraded tier** (``degrade_queue_depth`` / ``max_queue_age_ms``): past
+  the soft threshold — or when the oldest queued request has aged past the
+  budget (a stalled pipeline, not a burst) — only CACHE-HIT traffic is
+  admitted. A cold episode pays the full inner loop (~100x a cached
+  classify on CPU); shedding cold-adapt first keeps the cheap tier alive
+  at its SLO instead of letting one expensive request class starve both.
+
+The controller is pure policy over two live signals (queue depth, oldest
+queue age) — it owns no threads and takes no locks beyond the metric
+counters, so `admit` adds nanoseconds to the request path.
+"""
+
+from __future__ import annotations
+
+from ..engine import ServeConfig
+from ..errors import OverloadedError
+from ..metrics import ServeMetrics
+
+
+class AdmissionController:
+    """Shed-or-admit policy evaluated at the front door of every request."""
+
+    def __init__(self, config: ServeConfig, metrics: ServeMetrics):
+        self.config = config
+        self.metrics = metrics
+
+    # ------------------------------------------------------------------
+
+    def degraded(self, queue_depth: int, oldest_age_s: float) -> bool:
+        """True when the server should shed its expensive request class:
+        queue depth past the soft threshold, or the oldest queued request
+        older than the age budget."""
+        cfg = self.config
+        if 0 < cfg.degrade_queue_depth <= queue_depth:
+            return True
+        return oldest_age_s * 1e3 >= cfg.max_queue_age_ms > 0
+
+    def admit(
+        self, *, queue_depth: int, oldest_age_s: float, cache_hit: bool
+    ) -> None:
+        """Raises ``OverloadedError`` when the request must be shed; updates
+        the ``degraded`` gauge and ``shed_total`` counter either way."""
+        cfg = self.config
+        degraded = self.degraded(queue_depth, oldest_age_s)
+        self.metrics.degraded.set(1.0 if degraded else 0.0)
+        if queue_depth >= cfg.max_queue_depth:
+            self.metrics.shed_total.inc()
+            raise OverloadedError(
+                f"queue depth {queue_depth} at the {cfg.max_queue_depth} "
+                "hard limit — request shed",
+                retry_after_s=cfg.retry_after_s,
+            )
+        if degraded and not cache_hit:
+            self.metrics.shed_total.inc()
+            raise OverloadedError(
+                "server degraded (queue depth "
+                f"{queue_depth}, oldest wait {oldest_age_s * 1e3:.0f} ms) — "
+                "cold-adapt request shed; cached support sets still served",
+                retry_after_s=cfg.retry_after_s,
+            )

@@ -1,0 +1,360 @@
+"""Replica flavors the pool supervises: one serving engine per replica.
+
+The pool (``serve/pool.py``) only ever talks to the small ``Replica``
+surface — classify, health, promote, terminate — so supervision,
+re-dispatch, and circuit breaking are written once and proven against the
+deterministic in-process flavor, then apply unchanged to the production
+subprocess flavor:
+
+* ``LocalReplica`` — a full ``ServingAPI`` (engine + batcher + cache) in
+  this process. Crash and wedge faults (``utils/faultinject.py``) are
+  interpreted as state transitions (dead → ``ReplicaDeadError``, wedged →
+  health checks time out), which makes every recovery path testable in
+  tier-1 under the compile guard — no subprocess nondeterminism.
+* ``HttpReplica`` — a client for a replica that lives behind a URL;
+  connection failures and timeouts surface as ``ReplicaDeadError`` so the
+  pool treats a dropped TCP connection exactly like an in-process death.
+* ``SubprocessReplica`` — the production shape: ``tools/serve_maml.py``
+  launched as a worker process (one engine, own XLA runtime, crash
+  isolation), found via a port file, spoken to through ``HttpReplica``.
+
+Idempotency note: ``serve_adapt``/``serve_classify`` are pure functions of
+(state, episode), so a request that died with its replica can be re-sent
+to any other replica and produce the identical answer — re-dispatch needs
+no dedup bookkeeping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from ...utils import faultinject
+from ..errors import (
+    OverloadedError,
+    ReplicaDeadError,
+    SwapRejectedError,
+)
+from .swap import promote_checkpoint
+
+
+class Replica:
+    """The surface the pool supervises. Subclasses raise
+    ``ReplicaDeadError`` from any method once the replica is gone."""
+
+    replica_id: str = "?"
+
+    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+        raise NotImplementedError
+
+    def healthz(self, *, timeout: float) -> dict:
+        raise NotImplementedError
+
+    def promote(self, checkpoint_path: str) -> dict:
+        raise NotImplementedError
+
+    def terminate(self) -> None:
+        raise NotImplementedError
+
+
+class LocalReplica(Replica):
+    """In-process replica: its own ``ServingAPI`` on this process's device
+    runtime. Deterministic stand-in for a worker process in tier-1 tests
+    (and the zero-dependency way to run a pool on one host)."""
+
+    def __init__(self, api, replica_id: str = "local"):
+        # ``api`` is a ServingAPI; duck-typed here to keep this module free
+        # of an import cycle with serve/api.py (which imports resilience).
+        self.api = api
+        self.replica_id = replica_id
+        self._dead = False
+        self._wedged = False
+
+    # -- fault interpretation ------------------------------------------
+    def _consult_faults(self) -> None:
+        fault = faultinject.serve_request_fault()
+        if fault == "kill":
+            self._dead = True
+        elif fault == "wedge":
+            self._wedged = True
+
+    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+        if self._dead:
+            raise ReplicaDeadError(f"replica {self.replica_id} is dead")
+        if self._wedged:
+            # A wedged process answers nothing: model it as the client-side
+            # timeout the pool would see, without actually burning `timeout`
+            # wall-clock in a test.
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} did not answer within {timeout} s"
+            )
+        self._consult_faults()
+        if self._dead:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} crashed serving this request"
+            )
+        # A freshly-armed wedge takes effect AFTER this request (the
+        # supervisor's health probes must be what detects it, exactly like
+        # a process that goes quiet between requests).
+        return self.api.classify(
+            x_support, y_support, x_query, timeout=timeout
+        )
+
+    def healthz(self, *, timeout: float) -> dict:
+        if self._dead:
+            raise ReplicaDeadError(f"replica {self.replica_id} is dead")
+        if self._wedged:
+            raise TimeoutError(
+                f"replica {self.replica_id} health check timed out "
+                f"({timeout} s)"
+            )
+        return self.api.healthz()
+
+    def promote(self, checkpoint_path: str) -> dict:
+        if self._dead or self._wedged:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} cannot take a promotion"
+            )
+        result = promote_checkpoint(self.api.engine, checkpoint_path)
+        return {
+            "state_version": result.version,
+            "buckets_canaried": len(result.buckets_canaried),
+        }
+
+    def terminate(self) -> None:
+        self._dead = True
+        self.api.close()
+
+
+class HttpReplica(Replica):
+    """Client for a replica behind a URL. Transport-level failures —
+    refused/reset connections, timeouts, a mid-response hangup — all mean
+    the same thing to the pool: this replica cannot answer; raise
+    ``ReplicaDeadError`` and let supervision sort out why."""
+
+    def __init__(self, base_url: str, replica_id: str = "http"):
+        self.base_url = base_url.rstrip("/")
+        self.replica_id = replica_id
+
+    def _request(self, path: str, payload: dict | None, timeout: float) -> dict:
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as exc:
+            body = {}
+            try:
+                body = json.load(exc)
+            except Exception:
+                pass
+            detail = body.get("error", str(exc))
+            if exc.code == 503:
+                raise OverloadedError(
+                    f"replica {self.replica_id}: {detail}",
+                    retry_after_s=float(exc.headers.get("Retry-After", 1.0)),
+                ) from None
+            if exc.code == 409:
+                raise SwapRejectedError(
+                    f"replica {self.replica_id}: {detail}",
+                    reason=body.get("reason", "canary"),
+                ) from None
+            if 400 <= exc.code < 500:
+                raise ValueError(
+                    f"replica {self.replica_id}: {detail}"
+                ) from None
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} answered {exc.code}: {detail}"
+            ) from None
+        except (urllib.error.URLError, ConnectionError, OSError) as exc:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} unreachable: {exc}"
+            ) from exc
+
+    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+        payload = {
+            "support": np.asarray(x_support).tolist(),
+            "support_labels": np.asarray(y_support).tolist(),
+            "query": np.asarray(x_query).tolist(),
+        }
+        return self._request("/v1/episode", payload, timeout)
+
+    def healthz(self, *, timeout: float) -> dict:
+        try:
+            return self._request("/healthz", None, timeout)
+        except OverloadedError as exc:
+            # /healthz 503 = alive but not ready (warming up); report it as
+            # health data, not replica death.
+            return {"status": "unready", "ready": False, "detail": str(exc)}
+
+    def promote(self, checkpoint_path: str) -> dict:
+        return self._request(
+            "/admin/promote", {"checkpoint": checkpoint_path}, timeout=600.0
+        )
+
+    def terminate(self) -> None:  # nothing to own: the URL outlives us
+        pass
+
+
+class SubprocessReplica(Replica):
+    """The production replica: a worker process running
+    ``tools/serve_maml.py`` (one engine, own XLA runtime, crash isolation),
+    announced through a port file, driven via :class:`HttpReplica`."""
+
+    def __init__(
+        self,
+        argv: list[str],
+        *,
+        replica_id: str = "proc",
+        env: dict | None = None,
+        startup_timeout_s: float = 120.0,
+        port_file: str,
+    ):
+        self.replica_id = replica_id
+        self._port_file = port_file
+        self._proc = subprocess.Popen(
+            argv,
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self._http: HttpReplica | None = None
+        self._startup_deadline = time.monotonic() + startup_timeout_s
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def _endpoint(self, timeout: float) -> HttpReplica:
+        """Resolves the worker's ephemeral port (blocking until the port
+        file appears or the startup budget runs out)."""
+        if self._http is not None:
+            return self._http
+        deadline = min(self._startup_deadline, time.monotonic() + timeout)
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise ReplicaDeadError(
+                    f"replica {self.replica_id} exited rc="
+                    f"{self._proc.returncode} before binding a port"
+                )
+            try:
+                with open(self._port_file) as f:
+                    port = int(f.read().strip())
+                self._http = HttpReplica(
+                    f"http://127.0.0.1:{port}", replica_id=self.replica_id
+                )
+                return self._http
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        raise ReplicaDeadError(
+            f"replica {self.replica_id} did not announce a port within its "
+            "startup budget"
+        )
+
+    def _check_process(self) -> None:
+        if self._proc.poll() is not None:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} process exited rc="
+                f"{self._proc.returncode}"
+            )
+
+    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+        self._check_process()
+        return self._endpoint(timeout).classify(
+            x_support, y_support, x_query, timeout=timeout
+        )
+
+    def healthz(self, *, timeout: float) -> dict:
+        self._check_process()
+        try:
+            endpoint = self._endpoint(timeout)
+        except ReplicaDeadError:
+            if (
+                self._proc.poll() is None
+                and time.monotonic() < self._startup_deadline
+            ):
+                # Alive, just hasn't bound a port yet (jax import + warmup
+                # takes seconds): not-ready, NOT dead — the supervisor must
+                # not strike a replica for booting.
+                return {"status": "starting", "ready": False}
+            raise
+        return endpoint.healthz(timeout=timeout)
+
+    def promote(self, checkpoint_path: str) -> dict:
+        self._check_process()
+        return self._endpoint(60.0).promote(checkpoint_path)
+
+    def terminate(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=10)
+        try:
+            os.remove(self._port_file)
+        except OSError:
+            pass
+
+
+def serve_maml_argv(
+    config_path: str,
+    *,
+    port_file: str,
+    checkpoint: str | None = None,
+    learner: str = "maml",
+    warmup: str = "",
+    max_batch: int = 4,
+    max_wait_ms: float = 2.0,
+    cache_capacity: int | None = None,
+    max_queue_depth: int | None = None,
+    degrade_queue_depth: int | None = None,
+    max_queue_age_ms: float | None = None,
+    retry_after_s: float | None = None,
+    repo_root: str | None = None,
+) -> list[str]:
+    """Builds the worker argv for a :class:`SubprocessReplica` slot —
+    shared by the ``tools/serve_maml.py --replicas N`` front door and the
+    pool tests. Cache/admission knobs are forwarded when given (``None``
+    keeps the worker CLI default) — a pool front door must never silently
+    drop the operator's configured limits on the workers that enforce
+    them."""
+    root = repo_root or os.getcwd()
+    argv = [
+        sys.executable,
+        os.path.join(root, "tools", "serve_maml.py"),
+        "--config", config_path,
+        "--port", "0",
+        "--port_file", port_file,
+        "--learner", learner,
+        "--max_batch", str(max_batch),
+        "--max_wait_ms", str(max_wait_ms),
+    ]
+    for flag, value in (
+        ("--cache_capacity", cache_capacity),
+        ("--max_queue_depth", max_queue_depth),
+        ("--degrade_queue_depth", degrade_queue_depth),
+        ("--max_queue_age_ms", max_queue_age_ms),
+        ("--retry_after_s", retry_after_s),
+    ):
+        if value is not None:
+            argv += [flag, str(value)]
+    if warmup:
+        argv += ["--warmup", warmup]
+    if checkpoint:
+        argv += ["--checkpoint", checkpoint]
+    else:
+        argv += ["--init_from_scratch"]
+    return argv

@@ -1,0 +1,130 @@
+"""Safe hot-swap: verify, canary, then publish — never the reverse.
+
+``ServingEngine.update_state`` is a raw publish: it will happily put a
+corrupt or NaN-producing checkpoint in front of live traffic. Promotion
+routes every swap through three gates BEFORE the state digest bumps:
+
+1. **manifest verification** — the candidate file is loaded through the
+   PR 3 integrity pipeline (``utils/checkpoint.load_for_inference``: full
+   archive manifest, per-leaf CRCs, typed ``CheckpointCorruptError`` /
+   ``ValueError`` split), after the ``corrupt_swap_at`` fault hook so the
+   rejection path is provable;
+2. **canary episodes** — one synthetic episode per warmed bucket runs
+   against the CANDIDATE state (``engine.canary_probe``), riding the
+   already-compiled programs (identical shapes — a canary mints no new
+   program signatures) with finite-logits checks;
+3. **publish** — only after every canary passes does
+   ``engine.update_state`` swap atomically.
+
+Because verification happens pre-publish there is nothing to roll back:
+a rejected promotion leaves the old state serving bit-exact, with a
+``swap_rejected`` telemetry event and ``swap_rejected_total`` counter as
+the only side effects. Callers get ``SwapRejectedError`` (or the typed
+checkpoint error) to surface upstream (HTTP 409 at the front door).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ...telemetry import events as telemetry_events
+from ...utils import faultinject
+from ...utils.checkpoint import CheckpointError
+from ..engine import ServingEngine
+from ..errors import SwapRejectedError
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapResult:
+    """Outcome of an accepted promotion."""
+
+    version: int  # the state version now serving
+    buckets_canaried: tuple[tuple[int, int, int], ...]
+    source: str  # checkpoint path or "<in-memory>"
+
+
+def promote_state(
+    engine: ServingEngine,
+    state,
+    *,
+    buckets=None,
+    source: str = "<in-memory>",
+) -> SwapResult:
+    """Canaries ``state`` (already in memory) and publishes it on success.
+
+    ``buckets`` defaults to every warmed bucket; pass an explicit list to
+    extend or narrow the probe set. Raises ``SwapRejectedError`` on a
+    failed canary — the previous state is still serving, untouched."""
+    candidate = engine.learner.inference_state(state)
+    try:
+        probed = engine.canary_probe(candidate, buckets)
+    except SwapRejectedError as exc:
+        engine.metrics.swap_rejected_total.inc()
+        telemetry_events.emit(
+            "swap_rejected",
+            source=source,
+            reason=exc.reason,
+            detail=str(exc),
+            state_version=engine.state_version,
+        )
+        raise
+    version = engine.update_state(candidate)
+    engine.metrics.swaps_total.inc()
+    telemetry_events.emit(
+        "swap_promoted",
+        source=source,
+        state_version=version,
+        buckets=["x".join(str(d) for d in b) for b in probed],
+    )
+    return SwapResult(
+        version=version,
+        buckets_canaried=tuple(probed),
+        source=source,
+    )
+
+
+def promote_checkpoint(
+    engine: ServingEngine, checkpoint_path: str, *, buckets=None
+) -> SwapResult:
+    """Loads ``checkpoint_path`` through the manifest-verified inference
+    loader, then canaries + publishes via :func:`promote_state`.
+
+    Raises ``SwapRejectedError`` for every rejection class — integrity
+    failures and architecture mismatches are wrapped (reason
+    ``corrupt_checkpoint`` / ``incompatible_checkpoint``) so one except
+    clause at the front door covers the whole verdict surface; the
+    underlying typed error rides along as ``__cause__``."""
+    faultinject.swap_checkpoint_loading(checkpoint_path)
+    try:
+        state, _experiment_state = engine.learner.load_inference_state(
+            checkpoint_path
+        )
+    except CheckpointError as exc:
+        engine.metrics.swap_rejected_total.inc()
+        telemetry_events.emit(
+            "swap_rejected",
+            source=checkpoint_path,
+            reason="corrupt_checkpoint",
+            detail=str(exc),
+            state_version=engine.state_version,
+        )
+        raise SwapRejectedError(
+            f"checkpoint failed integrity verification: {exc}",
+            reason="corrupt_checkpoint",
+        ) from exc
+    except ValueError as exc:
+        engine.metrics.swap_rejected_total.inc()
+        telemetry_events.emit(
+            "swap_rejected",
+            source=checkpoint_path,
+            reason="incompatible_checkpoint",
+            detail=str(exc),
+            state_version=engine.state_version,
+        )
+        raise SwapRejectedError(
+            f"checkpoint does not match the served architecture: {exc}",
+            reason="incompatible_checkpoint",
+        ) from exc
+    return promote_state(
+        engine, state, buckets=buckets, source=checkpoint_path
+    )

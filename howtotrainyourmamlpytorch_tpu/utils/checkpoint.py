@@ -377,6 +377,33 @@ def load_checkpoint(
     return jax.tree.unflatten(treedef, restored), experiment_state
 
 
+def verify_checkpoint(
+    filepath: str,
+    *,
+    retries: int = READ_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> dict:
+    """Integrity-verifies an archive WITHOUT restoring it: full manifest
+    check (leaf count, per-leaf CRCs, experiment-state CRC) against no
+    template — the front-door gate of a pool-wide hot swap
+    (``serve/pool.ReplicaPool.promote``), where a corrupt file must be
+    rejected once, cheaply, before any replica spends a load + canary on
+    it. Returns a summary (``leaves``, ``bytes``, ``has_manifest``,
+    ``experiment_state``); raises the same typed errors as
+    ``load_checkpoint`` (``CheckpointCorruptError`` / ``CheckpointError``).
+    Structural compatibility with a given learner is NOT checked here —
+    that needs a template and stays with the loaders."""
+    leaves, manifest, experiment_state = _read_verified(
+        filepath, retries, backoff_s
+    )
+    return {
+        "leaves": len(leaves),
+        "bytes": os.path.getsize(filepath),
+        "has_manifest": manifest is not None,
+        "experiment_state": experiment_state,
+    }
+
+
 def load_for_inference(
     filepath: str,
     template_tree: Tree,

@@ -17,6 +17,26 @@ This module provides the failure points those tests drive:
 * ``sigterm_at_iter`` — deliver ``SIGTERM`` to this process right after
   iteration I's dispatch completes (TPU preemption).
 
+Serve-path faults (the resilience layer's recovery paths, ``serve/pool.py``
+and ``serve/resilience`` — mirrored onto the request path exactly like the
+four training pillars above):
+
+* ``replica_kill_at_request`` — the replica serving the Kth classify
+  request (counted process-globally from plan activation, 1-based) dies:
+  an in-process ``LocalReplica`` transitions to dead and raises
+  ``ReplicaDeadError``; a subprocess replica's HTTP handler hard-exits the
+  worker process (``os._exit``), so the front door sees a dropped
+  connection — proving crash-recovery re-dispatch end-to-end;
+* ``wedge_replica_at_request`` — same trigger, but the replica WEDGES: it
+  stops answering health checks (and requests) without dying, proving the
+  supervisor's liveness detection and replacement path;
+* ``corrupt_swap_at`` — truncate the checkpoint file at byte N the next
+  time a hot-swap promotion loads it (``serve/resilience/swap.py``),
+  proving the manifest-verify rejection path;
+* ``nan_next_logits`` — poison the next K classify outputs with NaNs at
+  the logits boundary, proving the canary's finite-logits rejection (a
+  NaN-producing checkpoint must never be promoted into live traffic).
+
 Activation is programmatic (``activate(FaultPlan(...))`` from tests) or via
 the environment: ``MAML_FAULTS="nan_at_iter=40,sigterm_at_iter=120"``
 (comma/semicolon-separated ``key=int`` pairs), read once on first use so a
@@ -51,10 +71,15 @@ class FaultPlan:
     fail_next_writes: int = 0
     nan_at_iter: int | None = None
     sigterm_at_iter: int | None = None
+    replica_kill_at_request: int | None = None
+    wedge_replica_at_request: int | None = None
+    corrupt_swap_at: int | None = None
+    nan_next_logits: int = 0
 
 
 _UNSET = object()  # env not yet consulted
 _plan: FaultPlan | None | object = _UNSET
+_serve_requests = 0  # process-global classify-request count (serve faults)
 
 
 def _plan_from_env() -> FaultPlan | None:
@@ -91,24 +116,29 @@ def current_plan() -> FaultPlan | None:
 
 
 def activate(plan: FaultPlan) -> FaultPlan:
-    """Installs ``plan`` (overriding any env plan) and clears ``events``."""
-    global _plan
+    """Installs ``plan`` (overriding any env plan), clears ``events``, and
+    restarts the serve-request counter (serve faults trigger at "the Kth
+    request after activation")."""
+    global _plan, _serve_requests
     _plan = plan
+    _serve_requests = 0
     events.clear()
     return plan
 
 
 def deactivate() -> None:
     """Removes any active plan; the env var is NOT re-read (use ``reset``)."""
-    global _plan
+    global _plan, _serve_requests
     _plan = None
+    _serve_requests = 0
     events.clear()
 
 
 def reset() -> None:
     """Back to the pristine state: next hook call re-reads ``MAML_FAULTS``."""
-    global _plan
+    global _plan, _serve_requests
     _plan = _UNSET
+    _serve_requests = 0
     events.clear()
 
 
@@ -174,3 +204,63 @@ def sigterm_due(iters_done: int) -> None:
         plan.sigterm_at_iter = None
         events.append(f"sigterm:{iters_done}")
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+# ---------------------------------------------------------------------------
+# Serve-path failure points (serve/pool.py, serve/resilience)
+# ---------------------------------------------------------------------------
+
+
+def serve_request_fault() -> str | None:
+    """Called by each replica frontend once per classify request; returns
+    ``"kill"`` / ``"wedge"`` when this request is the planned Kth one (the
+    caller decides what death/wedging means for its replica flavor: an
+    in-process replica raises ``ReplicaDeadError`` / drops health checks, a
+    subprocess replica ``os._exit``s or stalls its handlers), else
+    ``None``. Requests are counted process-globally from plan activation,
+    1-based, so round-robin pools hit a deterministic replica."""
+    global _serve_requests
+    plan = _active()
+    if plan is None or (
+        plan.replica_kill_at_request is None
+        and plan.wedge_replica_at_request is None
+    ):
+        return None
+    _serve_requests += 1
+    if plan.replica_kill_at_request == _serve_requests:
+        plan.replica_kill_at_request = None
+        events.append(f"replica-kill:{_serve_requests}")
+        return "kill"
+    if plan.wedge_replica_at_request == _serve_requests:
+        plan.wedge_replica_at_request = None
+        events.append(f"replica-wedge:{_serve_requests}")
+        return "wedge"
+    return None
+
+
+def swap_checkpoint_loading(filepath: str) -> None:
+    """Called by checkpoint promotion (``serve/resilience/swap.py``) right
+    before the candidate file is read; applies the one-shot
+    ``corrupt_swap_at`` truncation so the manifest-verify rejection path is
+    provable without hand-crafting corrupt archives."""
+    plan = _active()
+    if plan is None or plan.corrupt_swap_at is None:
+        return
+    n = plan.corrupt_swap_at
+    plan.corrupt_swap_at = None
+    with open(filepath, "r+b") as f:
+        f.truncate(n)
+    events.append(f"corrupt-swap:{os.path.basename(filepath)}@{n}")
+
+
+def poison_logits(logits: np.ndarray) -> np.ndarray:
+    """Returns ``logits`` replaced by NaNs while ``nan_next_logits`` > 0 —
+    the logits-boundary stand-in for a numerically broken checkpoint.
+    Consulted by the serve engine on every classify output (canaries
+    included), host-side, after the device fetch."""
+    plan = _active()
+    if plan is None or plan.nan_next_logits <= 0:
+        return logits
+    plan.nan_next_logits -= 1
+    events.append(f"nan-logits:{plan.nan_next_logits}")
+    return np.full_like(np.asarray(logits, dtype=np.float32), np.nan)

@@ -106,6 +106,46 @@ def test_telemetry_subsystem_lints_clean_standalone():
             assert "graftlint: disable" not in f.read(), path
 
 
+def test_resilience_layer_lints_clean_standalone():
+    """The serving resilience layer (ISSUE 6) stays lint-clean as its own
+    target with ZERO suppressions: ``serve/pool.py``, the
+    ``serve/resilience`` package, and ``tools/serve_loadtest.py``. The
+    whole-package gate covers them transitively; this pin survives any
+    future LINT_TARGETS reshuffle, asserts the linter actually DISCOVERED
+    the modules (an empty scan would vacuously pass), and refuses inline
+    suppressions."""
+    serve_dir = os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "serve")
+    resilience_dir = os.path.join(serve_dir, "resilience")
+    pool_py = os.path.join(serve_dir, "pool.py")
+    errors_py = os.path.join(serve_dir, "errors.py")
+    loadtest_py = os.path.join(REPO, "tools", "serve_loadtest.py")
+    assert os.path.isdir(resilience_dir)
+    proc = run_cli(
+        resilience_dir, pool_py, errors_py, "tools/serve_loadtest.py"
+    )
+    assert proc.returncode == 0, (
+        "graftlint found violations in the resilience layer:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "graftlint: clean" in proc.stderr
+
+    from tools.graftlint import lint_paths
+    from tools.graftlint.engine import _collect_files
+
+    targets = [resilience_dir, pool_py, errors_py, loadtest_py]
+    scanned = _collect_files(targets)
+    names = {os.path.basename(p) for p in scanned}
+    assert {
+        "admission.py", "swap.py", "replica.py", "pool.py", "errors.py",
+        "serve_loadtest.py",
+    } <= names
+    assert lint_paths(targets) == []
+    # Zero suppressions: the layer must be clean on its own merits.
+    for path in scanned:
+        with open(path) as f:
+            assert "graftlint: disable" not in f.read(), path
+
+
 def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(

@@ -5,6 +5,7 @@ CLI plumbing (config-JSON learner build, warmup-spec parsing)."""
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -42,13 +43,15 @@ def tiny_cfg():
 
 @pytest.fixture
 def served():
-    """A running HTTP server over a tiny fresh-init learner; yields
-    ``(base_url, api)`` and guarantees clean shutdown."""
+    """A running HTTP server over a tiny fresh-init learner (warmed, so
+    ``/healthz`` reports ready); yields ``(base_url, api)`` and guarantees
+    clean shutdown."""
     learner = MAMLFewShotLearner(tiny_cfg())
     state = learner.init_state(jax.random.key(0))
     api = ServingAPI(
         learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=1.0)
     )
+    api.engine.warmup([(5, 1, 2)])
     server = make_http_server(api, port=0)  # ephemeral port
     port = server.server_address[1]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -91,6 +94,11 @@ def test_http_roundtrip_and_metrics_scrape(served, rng):
     status, health = get_json(f"{base}/healthz")
     assert status == 200
     assert health["status"] == "ok" and health["family"] == "maml"
+    # /healthz no longer lies: live queue/dispatch state rides along.
+    assert health["ready"] is True and health["degraded"] is False
+    assert health["queue_depth"] == 0
+    assert "last_dispatch_age_s" in health
+    assert health["warmed_buckets"] == ["5x1x2"]
 
     status, body = post_episode(base, episode_payload(rng))
     assert status == 200
@@ -142,6 +150,133 @@ def test_http_error_surface(served, rng):
 
 
 # ---------------------------------------------------------------------------
+# Resilience surface: honest /healthz, 503 + Retry-After, /admin/promote
+# ---------------------------------------------------------------------------
+
+
+def unwarmed_server():
+    learner = MAMLFewShotLearner(tiny_cfg())
+    api = ServingAPI(
+        learner,
+        learner.init_state(jax.random.key(0)),
+        ServeConfig(meta_batch_size=2, max_wait_ms=1.0),
+    )
+    server = make_http_server(api, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread, api, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def test_healthz_503_until_first_warmup(rng):
+    """A replica that has never produced logits must not attract traffic:
+    /healthz answers 503 with ``ready: false`` until warmup (or the first
+    dispatch) completes."""
+    server, thread, api, base = unwarmed_server()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get_json(f"{base}/healthz")
+        assert err.value.code == 503
+        body = json.load(err.value)
+        assert body["ready"] is False and body["status"] == "unready"
+        # First successful episode flips readiness without explicit warmup.
+        post_episode(base, episode_payload(rng))
+        status, health = get_json(f"{base}/healthz")
+        assert status == 200 and health["ready"] is True
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+        api.close()
+
+
+def test_shed_returns_503_with_retry_after(rng):
+    """Admission control at the HTTP front door: a hard-limit shed is a
+    503 with a Retry-After header, not a queued slow death."""
+    learner = MAMLFewShotLearner(tiny_cfg())
+    api = ServingAPI(
+        learner,
+        learner.init_state(jax.random.key(0)),
+        ServeConfig(
+            meta_batch_size=4,
+            max_wait_ms=60_000.0,  # park the first episode in the queue
+            max_queue_depth=1,
+            retry_after_s=2.5,
+        ),
+    )
+    api.engine.warmup([(5, 1, 2)])
+    server = make_http_server(api, port=0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    blocked = threading.Thread(
+        target=lambda: post_episode(base, episode_payload(rng)), daemon=True
+    )
+    try:
+        blocked.start()
+        deadline = time.monotonic() + 5
+        while api.batcher.queue_depth() < 1:
+            assert time.monotonic() < deadline, "queue never filled"
+            time.sleep(0.005)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_episode(base, episode_payload(rng))
+        assert err.value.code == 503
+        assert err.value.headers["Retry-After"] == "2.5"
+        body = json.load(err.value)
+        assert body["shed"] is True and "shed" in body["error"]
+        status, health = get_json(f"{base}/healthz")
+        assert status == 200  # ready, but honest about the pressure
+        assert health["shed_total"] >= 1
+        assert "maml_serve_shed_total 1" in api.metrics_text()
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+        api.close()
+        blocked.join(timeout=10)
+
+
+def test_admin_promote_roundtrip_and_rejection(served, rng, tmp_path):
+    """POST /admin/promote: a manifest-valid checkpoint swaps (200 + new
+    state version), a corrupt one is refused with 409 and the old state
+    keeps serving bit-exact."""
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import save_checkpoint
+
+    base, api = served
+    payload = episode_payload(rng)
+    _, before = post_episode(base, payload)
+    assert before["state_version"] == 0
+
+    learner = MAMLFewShotLearner(tiny_cfg())
+    ckpt = str(tmp_path / "promote_me")
+    save_checkpoint(
+        ckpt, learner.init_state(jax.random.key(7)), {"current_iter": 0}
+    )
+    req = urllib.request.Request(
+        f"{base}/admin/promote",
+        data=json.dumps({"checkpoint": ckpt}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        body = json.load(resp)
+    assert body["state_version"] == 1
+    assert body["buckets_canaried"] >= 1
+    _, after = post_episode(base, payload)
+    assert after["state_version"] == 1
+    assert after["logits"] != before["logits"], "new weights must answer"
+
+    # Corrupt checkpoint: rejected at 409, old (promoted) state unharmed.
+    with open(ckpt, "r+b") as f:
+        f.truncate(128)
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=60)
+    assert err.value.code == 409
+    assert json.load(err.value)["reason"] == "corrupt_checkpoint"
+    _, still = post_episode(base, payload)
+    assert still["state_version"] == 1
+    assert still["logits"] == after["logits"]
+
+
+# ---------------------------------------------------------------------------
 # serve_maml CLI plumbing
 # ---------------------------------------------------------------------------
 
@@ -177,3 +312,19 @@ def test_cli_warmup_spec_parsing():
     assert parse_warmup("") == []
     with pytest.raises(ValueError, match="WAYxSHOTxQUERY"):
         parse_warmup("5x1")
+
+
+def test_cli_pool_mode_requires_warmup(capsys):
+    """--replicas without --warmup would deadlock (workers never become
+    ready, the pool never routes) — the CLI must refuse up front."""
+    from tools.serve_maml import main
+
+    with pytest.raises(SystemExit) as exit_info:
+        main(
+            [
+                "--config", "whatever.json", "--init_from_scratch",
+                "--replicas", "2",
+            ]
+        )
+    assert exit_info.value.code == 2
+    assert "--warmup" in capsys.readouterr().err

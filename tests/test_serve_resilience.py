@@ -1,0 +1,692 @@
+"""Serving resilience layer (ISSUE 6): deterministic fault-injection
+proofs for every recovery path on the request side.
+
+The serving twin of ``tests/test_faultinject.py``: replica crash
+mid-stream recovers with ZERO failed requests (bounded re-dispatch,
+compile-guard-pinned to mint no new program signatures on healthy
+replicas), a wedged replica is detected by the supervisor and replaced
+within the health-check budget, corrupt/NaN checkpoint swaps are rejected
+with the old state still serving bit-exact, and overload sheds with
+typed 503s instead of unbounded queue growth.
+
+Everything runs in-process on tiny shapes (CPU, tier-1, no slow marker);
+one end-to-end test boots real worker SUBPROCESSES through the same pool
+to prove the production topology.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.serve import (
+    NoHealthyReplicaError,
+    OverloadedError,
+    PoolConfig,
+    ReplicaPool,
+    ServeConfig,
+    ServingAPI,
+    SwapRejectedError,
+)
+from howtotrainyourmamlpytorch_tpu.serve.resilience import (
+    AdmissionController,
+    LocalReplica,
+)
+from howtotrainyourmamlpytorch_tpu.telemetry import EventLog
+from howtotrainyourmamlpytorch_tpu.telemetry import events as telemetry_events
+from howtotrainyourmamlpytorch_tpu.telemetry.events import read_events
+from howtotrainyourmamlpytorch_tpu.utils import faultinject
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    save_checkpoint,
+    verify_checkpoint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg():
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            image_height=8,
+            image_width=8,
+            num_classes=5,
+            per_step_bn_statistics=True,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+    )
+
+
+# One learner for the module: engines jit their own program pairs anyway,
+# but the backbone init / config plumbing is shared.
+LEARNER = MAMLFewShotLearner(tiny_cfg())
+
+
+def make_api(**serve_kw):
+    defaults = dict(meta_batch_size=2, max_wait_ms=0.0)
+    defaults.update(serve_kw)
+    return ServingAPI(
+        LEARNER, LEARNER.init_state(jax.random.key(0)),
+        ServeConfig(**defaults),
+    )
+
+
+def episode(rng, way=5, shot=1, query=3):
+    img = (1, 8, 8)
+    xs = rng.rand(way * shot, *img).astype(np.float32)
+    ys = np.repeat(np.arange(way), shot).astype(np.int32)
+    xq = rng.rand(query, *img).astype(np.float32)
+    return xs, ys, xq
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.deactivate()
+    yield
+    faultinject.deactivate()
+
+
+def local_pool(n=2, warm_bucket=(5, 1, 3), **pool_kw):
+    """A LocalReplica pool over fresh tiny APIs, warmed before serving."""
+    def factory(index: int) -> LocalReplica:
+        api = make_api()
+        api.engine.warmup([warm_bucket])
+        return LocalReplica(api, replica_id=f"local-{index}")
+
+    defaults = dict(
+        n_replicas=n,
+        health_interval_s=0.02,
+        health_timeout_s=1.0,
+        unhealthy_after=2,
+        restart_backoff_s=0.05,
+        restart_backoff_max_s=1.0,
+        min_uptime_s=0.0,
+    )
+    defaults.update(pool_kw)
+    pool = ReplicaPool(factory, PoolConfig(**defaults))
+    assert pool.wait_ready(timeout=120.0), "pool never became healthy"
+    return pool
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection plumbing (the four new serve faults)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_faults_parse_from_env(monkeypatch):
+    monkeypatch.setenv(
+        faultinject.ENV_VAR,
+        "replica_kill_at_request=3,wedge_replica_at_request=7;"
+        "corrupt_swap_at=128,nan_next_logits=2",
+    )
+    faultinject.reset()
+    plan = faultinject.current_plan()
+    assert plan.replica_kill_at_request == 3
+    assert plan.wedge_replica_at_request == 7
+    assert plan.corrupt_swap_at == 128
+    assert plan.nan_next_logits == 2
+    faultinject.reset()
+
+
+def test_serve_request_fault_counts_and_consumes():
+    faultinject.activate(faultinject.FaultPlan(replica_kill_at_request=2))
+    assert faultinject.serve_request_fault() is None  # request 1
+    assert faultinject.serve_request_fault() == "kill"  # request 2: fires
+    assert faultinject.serve_request_fault() is None  # consumed, one-shot
+    assert faultinject.events == ["replica-kill:2"]
+
+
+def test_poison_logits_is_counted_and_bounded():
+    faultinject.activate(faultinject.FaultPlan(nan_next_logits=1))
+    poisoned = faultinject.poison_logits(np.ones((2, 3), np.float32))
+    assert np.isnan(poisoned).all()
+    clean = faultinject.poison_logits(np.ones((2, 3), np.float32))
+    assert np.isfinite(clean).all(), "one-shot budget must be consumed"
+
+
+# ---------------------------------------------------------------------------
+# Admission control + graceful degradation
+# ---------------------------------------------------------------------------
+
+
+def test_admission_hard_limit_sheds_everything():
+    api = make_api(max_queue_depth=4, degrade_queue_depth=0)
+    ctrl = api.admission
+    ctrl.admit(queue_depth=3, oldest_age_s=0.0, cache_hit=False)  # admitted
+    with pytest.raises(OverloadedError, match="hard limit"):
+        ctrl.admit(queue_depth=4, oldest_age_s=0.0, cache_hit=True)
+    assert api.metrics.shed_total.value == 1
+    api.close()
+
+
+def test_admission_degraded_sheds_cold_keeps_cache_hits():
+    """Graceful degradation: past the soft threshold, cold-adapt traffic is
+    shed while cache-hit classify traffic keeps flowing."""
+    api = make_api(max_queue_depth=64, degrade_queue_depth=2)
+    ctrl = api.admission
+    with pytest.raises(OverloadedError, match="cold-adapt"):
+        ctrl.admit(queue_depth=2, oldest_age_s=0.0, cache_hit=False)
+    ctrl.admit(queue_depth=2, oldest_age_s=0.0, cache_hit=True)  # served
+    assert api.metrics.degraded.value == 1.0
+    ctrl.admit(queue_depth=0, oldest_age_s=0.0, cache_hit=False)
+    assert api.metrics.degraded.value == 0.0, "degradation must clear"
+    api.close()
+
+
+def test_admission_queue_age_degrades_even_at_low_depth():
+    api = make_api(max_queue_age_ms=100.0, degrade_queue_depth=64)
+    with pytest.raises(OverloadedError):
+        api.admission.admit(
+            queue_depth=1, oldest_age_s=0.2, cache_hit=False
+        )
+    api.close()
+
+
+def test_overload_sheds_instead_of_unbounded_queue(rng):
+    """End-to-end: with the queue parked (huge batching window), requests
+    past the hard limit get typed 503s and the queue stays BOUNDED."""
+    api = make_api(
+        meta_batch_size=8,
+        max_wait_ms=60_000.0,
+        max_queue_depth=3,
+        degrade_queue_depth=0,
+    )
+    api.engine.warmup([(5, 1, 3)])
+    workers = []
+    try:
+        for _ in range(3):  # park 3 requests in the queue
+            t = threading.Thread(
+                target=lambda: api.classify(*episode(rng), timeout=30),
+                daemon=True,
+            )
+            t.start()
+            workers.append(t)
+        deadline = time.monotonic() + 5
+        while api.batcher.queue_depth() < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        for _ in range(5):
+            with pytest.raises(OverloadedError) as err:
+                api.classify(*episode(rng))
+            assert err.value.retry_after_s > 0
+        assert api.batcher.queue_depth() <= 3, "queue must stay bounded"
+        assert api.metrics.shed_total.value == 5
+        assert api.healthz()["status"] in ("ok", "degraded")
+    finally:
+        api.close()
+        for t in workers:
+            t.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Safe hot-swap
+# ---------------------------------------------------------------------------
+
+
+def swap_checkpoint(tmp_path, name="swap_ckpt", key=7, poison_nan=False):
+    state = LEARNER.init_state(jax.random.key(key))
+    if poison_nan:
+        state = state._replace(
+            theta=jax.tree.map(
+                lambda a: np.full_like(np.asarray(a), np.nan), state.theta
+            )
+        )
+    path = str(tmp_path / name)
+    save_checkpoint(path, state, {"current_iter": 0})
+    return path
+
+
+def test_promote_accepts_good_checkpoint_no_new_signatures(
+    rng, tmp_path, compile_guard
+):
+    """A valid promotion canaries every warmed bucket against the candidate
+    and publishes — WITHOUT minting any new program signature (canaries
+    ride the compiled pair; a swap must never cause a compile storm)."""
+    api = make_api()
+    api.engine.warmup([(5, 1, 3), (5, 5, 3)])
+    before = api.classify(*episode(rng))
+    ckpt = swap_checkpoint(tmp_path)
+    with compile_guard() as guard:
+        result = api.promote(ckpt)
+    assert guard.count("serve_adapt_maml") == 0
+    assert guard.count("serve_classify_maml") == 0
+    assert result["state_version"] == 1
+    assert result["buckets_canaried"] == 2
+    after = api.classify(*episode(rng))
+    assert after["state_version"] == 1
+    assert before["state_version"] == 0
+    assert api.metrics.swaps_total.value == 1
+    api.close()
+
+
+def test_corrupt_swap_rejected_old_state_serves_bit_exact(rng, tmp_path):
+    """The ``corrupt_swap_at`` fault truncates the checkpoint right before
+    the promotion loads it: the manifest verify refuses it, and the old
+    state keeps serving bit-exact."""
+    api = make_api()
+    api.engine.warmup([(5, 1, 3)])
+    xs, ys, xq = episode(rng)
+    before = np.asarray(api.classify(xs, ys, xq)["logits"])
+    ckpt = swap_checkpoint(tmp_path)
+    faultinject.activate(faultinject.FaultPlan(corrupt_swap_at=256))
+    with pytest.raises(SwapRejectedError) as err:
+        api.promote(ckpt)
+    assert err.value.reason == "corrupt_checkpoint"
+    assert isinstance(err.value.__cause__, CheckpointCorruptError)
+    assert any(e.startswith("corrupt-swap:") for e in faultinject.events)
+    after = api.classify(xs, ys, xq)
+    assert after["state_version"] == 0
+    np.testing.assert_array_equal(np.asarray(after["logits"]), before)
+    assert api.metrics.swap_rejected_total.value == 1
+    api.close()
+
+
+def test_nan_checkpoint_rejected_by_canary(rng, tmp_path):
+    """A numerically-broken (all-NaN params) checkpoint passes the
+    manifest (its bytes are intact!) but the canary episode catches the
+    non-finite logits before publish."""
+    api = make_api()
+    api.engine.warmup([(5, 1, 3)])
+    xs, ys, xq = episode(rng)
+    before = np.asarray(api.classify(xs, ys, xq)["logits"])
+    ckpt = swap_checkpoint(tmp_path, poison_nan=True)
+    with pytest.raises(SwapRejectedError) as err:
+        api.promote(ckpt)
+    assert err.value.reason == "nonfinite_logits"
+    after = api.classify(xs, ys, xq)
+    assert after["state_version"] == 0
+    np.testing.assert_array_equal(np.asarray(after["logits"]), before)
+    api.close()
+
+
+def test_nan_logits_fault_rejects_swap_and_emits_event(rng, tmp_path):
+    """The ``nan_next_logits`` fault proves the finite-logits gate without
+    crafting a broken checkpoint, and the rejection emits a structured
+    ``swap_rejected`` telemetry event."""
+    api = make_api()
+    api.engine.warmup([(5, 1, 3)])
+    log = EventLog(str(tmp_path / "telemetry.jsonl"))
+    previous = telemetry_events.install(log)
+    try:
+        faultinject.activate(faultinject.FaultPlan(nan_next_logits=1))
+        with pytest.raises(SwapRejectedError):
+            api.promote(swap_checkpoint(tmp_path))
+        log.flush()
+    finally:
+        telemetry_events.install(previous)
+    rejected = [
+        e for e in read_events(log.path) if e["type"] == "swap_rejected"
+    ]
+    assert len(rejected) == 1
+    assert rejected[0]["reason"] == "nonfinite_logits"
+    assert rejected[0]["state_version"] == 0
+    api.close()
+
+
+def test_verify_checkpoint_front_door(tmp_path):
+    ckpt = swap_checkpoint(tmp_path)
+    summary = verify_checkpoint(ckpt)
+    assert summary["has_manifest"] is True
+    assert summary["leaves"] > 0
+    with open(ckpt, "r+b") as f:
+        f.truncate(200)
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(ckpt)
+
+
+# ---------------------------------------------------------------------------
+# Replica pool: crash recovery, wedge detection, circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_replica_crash_mid_stream_zero_failed_requests(rng, compile_guard):
+    """THE tentpole proof: a replica dies serving request K; the pool
+    re-dispatches onto the healthy replica and every request in the stream
+    is answered — zero failures, and the recovery window mints ZERO new
+    program signatures on the healthy replica (both replicas were warmed;
+    re-dispatch rides existing programs)."""
+    pool = local_pool(n=2, restart_backoff_s=600.0)  # no restart mid-test
+    try:
+        faultinject.activate(
+            faultinject.FaultPlan(replica_kill_at_request=3)
+        )
+        with compile_guard() as guard:
+            for i in range(8):
+                out = pool.classify(*episode(rng))
+                assert np.asarray(out["logits"]).shape == (3, 5)
+        assert guard.count("serve_adapt_maml") == 0
+        assert guard.count("serve_classify_maml") == 0
+        assert "replica-kill:3" in faultinject.events
+        assert pool.metrics.retry_total.value == 1
+        assert pool.metrics.replica_deaths_total.value == 1
+        assert pool.metrics.request_errors.value == 0
+        health = pool.healthz()
+        assert health["healthy_replicas"] == 1
+        assert health["degraded"] is True and health["ready"] is True
+    finally:
+        pool.close()
+
+
+def test_supervisor_restarts_crashed_replica(rng):
+    pool = local_pool(n=2, restart_backoff_s=0.02)
+    try:
+        faultinject.activate(
+            faultinject.FaultPlan(replica_kill_at_request=1)
+        )
+        pool.classify(*episode(rng))  # kills one replica; re-dispatched
+        deadline = time.monotonic() + 60
+        while pool.healthz()["healthy_replicas"] < 2:
+            assert time.monotonic() < deadline, "replica never restarted"
+            time.sleep(0.02)
+        assert pool.metrics.replica_restarts_total.value == 1
+        pool.classify(*episode(rng))  # the reborn fleet serves
+    finally:
+        pool.close()
+
+
+def test_wedged_replica_detected_and_replaced_within_budget(rng):
+    """A replica that stops answering health checks (but holds its slot)
+    is detected by the supervisor within ``unhealthy_after *
+    health_interval + health_timeout`` and replaced."""
+    pool = local_pool(n=2, restart_backoff_s=0.02, health_interval_s=0.02)
+    try:
+        faultinject.activate(
+            faultinject.FaultPlan(wedge_replica_at_request=1)
+        )
+        out = pool.classify(*episode(rng))  # arms the wedge; still answers
+        assert np.asarray(out["logits"]).shape == (3, 5)
+        assert "replica-wedge:1" in faultinject.events
+        t0 = time.monotonic()
+        deadline = t0 + 60
+        saw_death = False
+        while time.monotonic() < deadline:
+            if pool.metrics.replica_deaths_total.value >= 1:
+                saw_death = True
+                break
+            time.sleep(0.01)
+        assert saw_death, "supervisor never detected the wedged replica"
+        while pool.healthz()["healthy_replicas"] < 2:
+            assert time.monotonic() < deadline, "replacement never came up"
+            time.sleep(0.02)
+        assert pool.metrics.replica_restarts_total.value >= 1
+        # Traffic flowed around the wedge the whole time.
+        pool.classify(*episode(rng))
+        assert pool.metrics.request_errors.value == 0
+    finally:
+        pool.close()
+
+
+def test_crash_loop_trips_circuit_breaker(rng):
+    """A slot whose replica keeps dying young is parked (circuit open)
+    instead of restart-looping; the pool keeps serving on the healthy
+    slot and reports itself degraded."""
+    calls = {"bad": 0}
+
+    def factory(index: int):
+        if index == 1:
+            calls["bad"] += 1
+            raise RuntimeError("this replica never comes up")
+        api = make_api()
+        api.engine.warmup([(5, 1, 3)])
+        return LocalReplica(api, replica_id=f"local-{index}")
+
+    pool = ReplicaPool(
+        factory,
+        PoolConfig(
+            n_replicas=2,
+            health_interval_s=0.02,
+            restart_backoff_s=0.01,
+            restart_backoff_max_s=0.05,
+            min_uptime_s=0.0,
+            circuit_breaker_after=3,
+        ),
+    )
+    try:
+        assert pool.wait_ready(timeout=60, healthy=1)
+        deadline = time.monotonic() + 30
+        while pool.metrics.circuit_open_total.value < 1:
+            assert time.monotonic() < deadline, "breaker never tripped"
+            time.sleep(0.02)
+        assert calls["bad"] == 3, "breaker must stop further restarts"
+        time.sleep(0.2)
+        assert calls["bad"] == 3
+        health = pool.healthz()
+        assert health["degraded"] is True and health["ready"] is True
+        states = {r["index"]: r["state"] for r in health["replicas"]}
+        assert states[1] == "circuit_open"
+        out = pool.classify(*episode(np.random.RandomState(0)))
+        assert np.asarray(out["logits"]).shape == (3, 5)
+    finally:
+        pool.close()
+
+
+def test_no_healthy_replica_is_typed_503(rng):
+    def factory(index: int):
+        raise RuntimeError("fleet is down")
+
+    pool = ReplicaPool(
+        factory,
+        PoolConfig(
+            n_replicas=1,
+            health_interval_s=0.02,
+            restart_backoff_s=0.01,
+            circuit_breaker_after=1,
+        ),
+    )
+    try:
+        with pytest.raises(NoHealthyReplicaError) as err:
+            pool.classify(*episode(rng))
+        assert isinstance(err.value, OverloadedError)  # maps to 503
+        assert pool.metrics.shed_total.value == 1
+        assert pool.healthz()["ready"] is False
+    finally:
+        pool.close()
+
+
+def test_pool_promote_rejects_corrupt_checkpoint_at_front_door(
+    rng, tmp_path
+):
+    """A corrupt checkpoint is refused ONCE by the front-door manifest
+    verify — no replica spends a load or canary on it, and every replica
+    keeps serving the old version."""
+    pool = local_pool(n=2, restart_backoff_s=600.0)
+    try:
+        ckpt = swap_checkpoint(tmp_path)
+        with open(ckpt, "r+b") as f:
+            f.truncate(300)
+        with pytest.raises(SwapRejectedError) as err:
+            pool.promote(ckpt)
+        assert err.value.reason == "corrupt_checkpoint"
+        out = pool.classify(*episode(rng))
+        assert out["state_version"] == 0
+    finally:
+        pool.close()
+
+
+def test_pool_promote_rolls_good_checkpoint_to_all_replicas(rng, tmp_path):
+    pool = local_pool(n=2, restart_backoff_s=600.0)
+    try:
+        result = pool.promote(swap_checkpoint(tmp_path))
+        assert result["promoted_replicas"] == 2
+        for _ in range(2):  # round-robin touches both replicas
+            assert pool.classify(*episode(rng))["state_version"] == 1
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Loadtest smoke (tier-1: tiny request count, in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_loadtest_smoke_in_process(rng):
+    from tools.serve_loadtest import run_loadtest, synth_episodes
+
+    api = make_api(
+        meta_batch_size=4, max_wait_ms=2.0,
+        max_queue_depth=128, degrade_queue_depth=0,
+    )
+    api.engine.warmup([(5, 1, 3)])
+    try:
+        result = run_loadtest(
+            api,
+            synth_episodes(4, way=5, shot=1, query=3, image_shape=(1, 8, 8)),
+            rate_qps=20.0,
+            duration_s=0.8,
+            p99_budget_ms=30_000.0,
+            error_slo=0.01,
+            seed=0,
+        )
+    finally:
+        api.close()
+    assert result["offered"] > 0
+    assert result["completed_ok"] == result["offered"]
+    assert result["serve_error_rate"] == 0.0
+    assert result["slo_pass"] is True
+    assert result["serve_slo_p99_ms"] == 30_000.0
+    assert result["serve_loadtest_p99_ms"] > 0
+    assert result["serve_recovery_s"] == 0.0
+    # The SLO verdict keys serve_bench.py re-exports are all present.
+    for key in (
+        "serve_loadtest_qps", "serve_error_rate", "serve_recovery_s",
+        "serve_slo_p99_ms", "slo_pass", "shed", "deadline_exceeded",
+    ):
+        assert key in result
+    json.dumps(result)  # --json output must be serializable as-is
+
+
+def test_loadtest_counts_sheds_and_fails_verdict(rng):
+    """An overloaded target cannot produce a passing verdict: sheds count
+    into the error rate."""
+    from tools.serve_loadtest import run_loadtest, synth_episodes
+
+    api = make_api(
+        meta_batch_size=8,
+        max_wait_ms=60_000.0,  # park everything: all but the queue cap shed
+        max_queue_depth=1,
+        degrade_queue_depth=0,
+    )
+    api.engine.warmup([(5, 1, 3)])
+    try:
+        result = run_loadtest(
+            api,
+            synth_episodes(4, way=5, shot=1, query=3, image_shape=(1, 8, 8)),
+            rate_qps=30.0,
+            duration_s=0.7,
+            p99_budget_ms=30_000.0,
+            error_slo=0.01,
+            timeout_s=1.0,
+            seed=1,
+        )
+    finally:
+        api.close()
+    assert result["shed"] + result["deadline_exceeded"] > 0
+    assert result["serve_error_rate"] > 0.01
+    assert result["slo_pass"] is False
+
+
+# ---------------------------------------------------------------------------
+# Production topology: subprocess replicas end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_subprocess_pool_end_to_end(rng, tmp_path):
+    """The real thing, once: two ``tools/serve_maml.py`` worker PROCESSES
+    under pool supervision. Replica 0 is armed (via env) to hard-exit on
+    its first episode; the stream still answers every request, and the
+    supervisor respawns the dead worker."""
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.replica import (
+        SubprocessReplica,
+        serve_maml_argv,
+    )
+
+    cfg_json = {
+        "num_stages": 2,
+        "cnn_num_filters": 4,
+        "num_classes_per_set": 5,
+        "image_height": 8,
+        "image_width": 8,
+        "image_channels": 1,
+        "per_step_bn_statistics": True,
+        "number_of_training_steps_per_iter": 2,
+        "number_of_evaluation_steps_per_iter": 2,
+    }
+    config_path = str(tmp_path / "serve_cfg.json")
+    with open(config_path, "w") as f:
+        json.dump(cfg_json, f)
+
+    armed = {"fault": True}  # only the FIRST replica-0 spawn gets the fault
+
+    def factory(index: int) -> SubprocessReplica:
+        port_file = os.path.join(
+            str(tmp_path), f"replica_{index}_{time.monotonic_ns()}.port"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop(faultinject.ENV_VAR, None)
+        if index == 0 and armed.pop("fault", False):
+            env[faultinject.ENV_VAR] = "replica_kill_at_request=1"
+        argv = serve_maml_argv(
+            config_path,
+            port_file=port_file,
+            warmup="5x1x3",
+            max_batch=2,
+            max_wait_ms=1.0,
+            repo_root=REPO,
+        )
+        return SubprocessReplica(
+            argv,
+            replica_id=f"worker-{index}",
+            env=env,
+            port_file=port_file,
+            startup_timeout_s=180.0,
+        )
+
+    pool = ReplicaPool(
+        factory,
+        PoolConfig(
+            n_replicas=2,
+            health_interval_s=0.2,
+            health_timeout_s=3.0,
+            unhealthy_after=2,
+            restart_backoff_s=0.1,
+            min_uptime_s=0.0,
+            dispatch_timeout_s=30.0,
+        ),
+    )
+    try:
+        assert pool.wait_ready(timeout=180.0), "subprocess pool never ready"
+        xs, ys, xq = episode(rng)
+        answered = 0
+        for _ in range(4):
+            out = pool.classify(xs, ys, xq, timeout=60.0)
+            assert np.asarray(out["logits"]).shape == (3, 5)
+            answered += 1
+        assert answered == 4, "zero failed requests across the worker crash"
+        assert pool.metrics.replica_deaths_total.value >= 1, (
+            "the armed worker must actually have died"
+        )
+        assert pool.metrics.retry_total.value >= 1
+        # Supervision respawns the dead worker process.
+        deadline = time.monotonic() + 120
+        while pool.healthz()["healthy_replicas"] < 2:
+            assert time.monotonic() < deadline, "worker never respawned"
+            time.sleep(0.2)
+        assert pool.metrics.replica_restarts_total.value >= 1
+        pool.classify(xs, ys, xq, timeout=60.0)
+    finally:
+        pool.close()

@@ -254,7 +254,11 @@ def test_batcher_groups_by_bucket(rng):
     assert table[(3, 1, 2)]["episodes"] == 2
 
 
-def test_batcher_propagates_dispatch_errors(rng, monkeypatch):
+def test_batcher_propagates_dispatch_errors_typed(rng, monkeypatch):
+    """Engine failures surface as DispatchFailedError (original exception
+    as __cause__) — callers branch on type, not message."""
+    from howtotrainyourmamlpytorch_tpu.serve import DispatchFailedError
+
     engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
     batcher = MicroBatcher(engine)
 
@@ -264,10 +268,98 @@ def test_batcher_propagates_dispatch_errors(rng, monkeypatch):
     monkeypatch.setattr(engine, "dispatch", boom)
     try:
         future = batcher.submit(engine.prepare_episode(*episode(rng)))
-        with pytest.raises(RuntimeError, match="device fell over"):
+        with pytest.raises(DispatchFailedError, match="device fell over") as err:
             future.result(timeout=30)
+        assert isinstance(err.value.__cause__, RuntimeError)
     finally:
         batcher.close()
+
+
+def test_batcher_worker_survives_poisoned_episode(rng):
+    """The fence (ISSUE 6 satellite): an exception escaping the dispatch
+    path fails the poisoned group's futures with a typed error and keeps
+    the worker alive — it must never strand every queued Future forever."""
+    from howtotrainyourmamlpytorch_tpu.serve import DispatchFailedError
+
+    engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+    batcher = MicroBatcher(engine)
+    try:
+        # A poisoned episode: hand-built (bypassing prepare_episode's
+        # validation) with a support/label length mismatch that detonates
+        # deep inside the engine at stack/pad time.
+        good = engine.prepare_episode(*episode(rng))
+        import dataclasses as dc
+
+        poisoned = dc.replace(
+            good, y_support=good.y_support[:-1], digest="poisoned"
+        )
+        bad_future = batcher.submit(poisoned)
+        with pytest.raises(DispatchFailedError):
+            bad_future.result(timeout=30)
+        assert batcher._worker.is_alive(), "worker thread must survive"
+        # The worker keeps serving: a fresh well-formed request succeeds.
+        ok_future = batcher.submit(engine.prepare_episode(*episode(rng)))
+        assert ok_future.result(timeout=30).shape == (3, 5)
+    finally:
+        batcher.close()
+
+
+def test_batcher_worker_survives_result_count_mismatch(rng, monkeypatch):
+    from howtotrainyourmamlpytorch_tpu.serve import DispatchFailedError
+
+    engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+    batcher = MicroBatcher(engine)
+    real_dispatch = engine.dispatch
+    monkeypatch.setattr(engine, "dispatch", lambda eps: [])
+    try:
+        future = batcher.submit(engine.prepare_episode(*episode(rng)))
+        with pytest.raises(DispatchFailedError, match="0 results"):
+            future.result(timeout=30)
+        monkeypatch.setattr(engine, "dispatch", real_dispatch)
+        ok = batcher.submit(engine.prepare_episode(*episode(rng)))
+        assert ok.result(timeout=30).shape == (3, 5)
+    finally:
+        batcher.close()
+
+
+def test_expired_deadline_dropped_before_dispatch(rng):
+    """A request whose deadline passed while queued is failed with
+    DeadlineExceededError and NOT dispatched — the device never runs work
+    nobody is waiting for."""
+    from howtotrainyourmamlpytorch_tpu.serve import DeadlineExceededError
+
+    engine = make_engine(meta_batch_size=4, max_wait_ms=30.0)
+    batcher = MicroBatcher(engine)
+    try:
+        ep = engine.prepare_episode(*episode(rng))
+        ep.deadline = time.monotonic()  # already expired on arrival
+        future = batcher.submit(ep)
+        with pytest.raises(DeadlineExceededError):
+            future.result(timeout=30)
+        assert engine.metrics.batches_dispatched.value == 0
+        assert engine.metrics.deadline_exceeded_total.value == 1
+        # DeadlineExceededError IS a TimeoutError (pre-resilience contract).
+        assert issubclass(DeadlineExceededError, TimeoutError)
+    finally:
+        batcher.close()
+
+
+def test_tight_deadline_flushes_group_early(rng):
+    """A short-budget request must not be parked for the full batching
+    window: its deadline tightens the group flush."""
+    engine = make_engine(meta_batch_size=4, max_wait_ms=60_000.0)
+    batcher = MicroBatcher(engine)
+    try:
+        ep = engine.prepare_episode(*episode(rng))
+        ep.deadline = time.monotonic() + 0.1
+        t0 = time.perf_counter()
+        future = batcher.submit(ep)
+        logits = future.result(timeout=30)
+        elapsed = time.perf_counter() - t0
+    finally:
+        batcher.close()
+    assert logits.shape == (3, 5)
+    assert elapsed < 30.0, "must flush at the deadline, not the 60 s window"
 
 
 def test_batcher_close_drains_and_rejects(rng):
@@ -307,6 +399,78 @@ def test_concurrent_submitters_all_answered(rng):
     assert not errors
     assert len(results) == 12
     assert all(v.shape == (3, 5) for v in results.values())
+
+
+# ---------------------------------------------------------------------------
+# Hot-swap concurrency (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_hot_swap_hammer_never_mixes_state_versions(rng):
+    """A writer thread hammers ``update_state`` while 8 reader threads
+    classify the SAME episode (cache off): every dispatch must return
+    logits bit-exact with ONE of the two pure states — any mixture (e.g.
+    adapt under v0, classify under v1) would produce a third value. This
+    pins the atomic published-state snapshot in the engine."""
+    learner = MAMLFewShotLearner(tiny_cfg())
+    s0 = learner.init_state(jax.random.key(0))
+    s1 = learner.init_state(jax.random.key(1))
+    engine = ServingEngine(
+        learner,
+        s0,
+        ServeConfig(meta_batch_size=2, max_wait_ms=0.0, cache_capacity=0),
+    )
+    xs, ys, xq = episode(rng)
+    ref0 = engine.dispatch([engine.prepare_episode(xs, ys, xq)])[0]
+    engine.update_state(s1)
+    ref1 = engine.dispatch([engine.prepare_episode(xs, ys, xq)])[0]
+    assert not np.array_equal(ref0, ref1)
+    engine.update_state(s0)
+
+    stop = threading.Event()
+    swap_count = [0]
+
+    def writer():
+        while not stop.is_set():
+            engine.update_state(s1 if swap_count[0] % 2 == 0 else s0)
+            swap_count[0] += 1
+            time.sleep(0.0005)
+
+    outputs: list[np.ndarray] = []
+    out_lock = threading.Lock()
+    errors: list[Exception] = []
+
+    def reader():
+        try:
+            for _ in range(12):
+                out = engine.dispatch(
+                    [engine.prepare_episode(xs, ys, xq)]
+                )[0]
+                with out_lock:
+                    outputs.append(out)
+        except Exception as exc:  # pragma: no cover - failure detail
+            errors.append(exc)
+
+    writer_thread = threading.Thread(target=writer, daemon=True)
+    readers = [
+        threading.Thread(target=reader, daemon=True) for _ in range(8)
+    ]
+    writer_thread.start()
+    for t in readers:
+        t.start()
+    for t in readers:
+        t.join(timeout=120)
+    stop.set()
+    writer_thread.join(timeout=10)
+    assert not errors
+    assert len(outputs) == 96
+    assert swap_count[0] > 0, "writer must actually have swapped"
+    matched0 = sum(1 for o in outputs if np.array_equal(o, ref0))
+    matched1 = sum(1 for o in outputs if np.array_equal(o, ref1))
+    assert matched0 + matched1 == len(outputs), (
+        "a dispatch mixed state versions: "
+        f"{len(outputs) - matched0 - matched1} outputs match neither state"
+    )
 
 
 # ---------------------------------------------------------------------------

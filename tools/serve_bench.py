@@ -19,9 +19,20 @@ with the PERF_NOTES.md "Serving path" keys:
                              (the zero-per-request-recompile receipt);
 * ``telemetry_overhead_pct`` — hot-path cost of the structured event sink
                              (``telemetry/events.py``): cache-hit qps with
-                             a sink installed vs without, back-to-back.
+                             a sink installed vs without, back-to-back;
+* ``serve_error_rate`` / ``serve_shed_total`` / ``serve_deadline_exceeded_total``
+                           — failures observed during the offered phases:
+                             an overloaded bench run reports its sheds and
+                             timeouts instead of healthy-looking qps;
+* ``serve_slo_p99_ms`` / ``serve_loadtest_p99_ms`` / ``serve_loadtest_error_rate``
+  / ``serve_recovery_s``   — the resilience receipt: an open-loop Poisson
+                             loadtest (``tools/serve_loadtest.py``) against
+                             a 2-replica in-process pool with a replica
+                             kill injected mid-stream; recovery is the
+                             measured death-to-full-health window.
 
-Usage: ``python tools/serve_bench.py [--tiny] [--budget-s 5]``
+Usage: ``python tools/serve_bench.py [--tiny] [--budget-s 5]
+[--skip-loadtest]``
 (``--tiny`` runs a 2-stage 14x14 net — CI-sized; default is the flagship
 64-filter 28x28 Omniglot config on the current backend, quiet-chip protocol
 per PERF_NOTES.md).
@@ -85,36 +96,59 @@ def build_api(tiny: bool, max_batch: int, max_wait_ms: float, cache: int):
 
 
 def episode_pool(api, n: int, shot: int = 1, query: int = 15, seed: int = 0):
-    """``n`` distinct synthetic episodes at the served way/shot/query."""
+    """``n`` distinct synthetic episodes at the served way/shot/query —
+    geometry derived from the api; generation shared with the loadtest
+    harness (one synthesis implementation, not two drifting copies)."""
+    from tools.serve_loadtest import synth_episodes
+
     bb = api.engine.learner.cfg.backbone
-    rng = np.random.RandomState(seed)
-    way = bb.num_classes
-    img = (bb.image_channels, bb.image_height, bb.image_width)
-    pool = []
-    for _ in range(n):
-        xs = rng.rand(way * shot, *img).astype(np.float32)
-        ys = np.repeat(np.arange(way), shot).astype(np.int32)
-        xq = rng.rand(query, *img).astype(np.float32)
-        pool.append((xs, ys, xq))
-    return pool
+    return synth_episodes(
+        n,
+        way=bb.num_classes,
+        shot=shot,
+        query=query,
+        image_shape=(bb.image_channels, bb.image_height, bb.image_width),
+        seed=seed,
+    )
 
 
-def offered_qps(api, episodes, budget_s: float, threads: int) -> float:
-    """Episodes/s with ``threads`` concurrent clients cycling ``episodes``."""
+def offered_qps(
+    api, episodes, budget_s: float, threads: int, errors: dict | None = None
+) -> float:
+    """SUCCESSFUL episodes/s with ``threads`` concurrent clients cycling
+    ``episodes``. Failed requests (sheds, deadlines, dispatch errors) are
+    tallied into ``errors`` (type name -> count) instead of silently
+    inflating the rate — an overloaded bench must not report
+    healthy-looking qps. Failures back off briefly: a synchronous shed
+    costs no device time, and 8 clients spinning at exception-throw speed
+    would burn the host and distort the very measurement the counters
+    exist for."""
+    from howtotrainyourmamlpytorch_tpu.serve.errors import ServeError
+
     stop_at = time.perf_counter() + budget_s
     counts = [0] * threads
+    failures: list[dict] = [{} for _ in range(threads)]
 
     def client(tid: int) -> None:
         i = tid
         while time.perf_counter() < stop_at:
             xs, ys, xq = episodes[i % len(episodes)]
-            api.classify(xs, ys, xq)
-            counts[tid] += 1
+            try:
+                api.classify(xs, ys, xq)
+                counts[tid] += 1
+            except (ServeError, TimeoutError) as exc:
+                name = type(exc).__name__
+                failures[tid][name] = failures[tid].get(name, 0) + 1
+                time.sleep(0.002)
             i += threads
 
     t0 = time.perf_counter()
     with ThreadPoolExecutor(threads) as pool:
         list(pool.map(client, range(threads)))
+    if errors is not None:
+        for per_thread in failures:
+            for name, count in per_thread.items():
+                errors[name] = errors.get(name, 0) + count
     return sum(counts) / (time.perf_counter() - t0)
 
 
@@ -127,6 +161,11 @@ def main(argv=None) -> int:
     parser.add_argument("--threads", type=int, default=8)
     parser.add_argument("--shot", type=int, default=1)
     parser.add_argument("--query", type=int, default=15)
+    parser.add_argument("--slo-p99-ms", type=float, default=5000.0,
+                        help="loadtest p99 budget (CPU-container default)")
+    parser.add_argument("--error-slo", type=float, default=0.02)
+    parser.add_argument("--skip-loadtest", action="store_true",
+                        help="skip the resilience loadtest phase")
     opts = parser.parse_args(argv)
 
     import jax
@@ -138,10 +177,13 @@ def main(argv=None) -> int:
     # Cold path: every episode must pay the inner loop. The pool cycles, so
     # the cache is disabled for this phase (capacity 0 = no store) — a long
     # budget would otherwise wrap the pool and silently measure hits.
+    bench_errors: dict[str, int] = {}
     cold_pool = episode_pool(api, n=64, shot=opts.shot, query=opts.query)
     api.engine.cache.clear()
     api.engine.cache.capacity = 0
-    serve_qps = offered_qps(api, cold_pool, opts.budget_s, opts.threads)
+    serve_qps = offered_qps(
+        api, cold_pool, opts.budget_s, opts.threads, errors=bench_errors
+    )
     api.engine.cache.capacity = 512
     adapt = api.metrics.adapt_latency.snapshot()
     classify = api.metrics.classify_latency.snapshot()
@@ -180,7 +222,10 @@ def main(argv=None) -> int:
         for with_sink in order:
             previous_sink = telemetry_events.install(log if with_sink else None)
             try:
-                rate = offered_qps(api, hot_pool, per_window, opts.threads)
+                rate = offered_qps(
+                    api, hot_pool, per_window, opts.threads,
+                    errors=bench_errors,
+                )
             finally:
                 telemetry_events.install(previous_sink)
             pair[with_sink] = rate
@@ -193,7 +238,73 @@ def main(argv=None) -> int:
     telemetry_qps = statistics.median(telemetry_rates)
     telemetry_overhead_pct = statistics.median(pair_overheads)
 
+    # Resilience phase: open-loop Poisson loadtest against a 2-replica
+    # LocalReplica pool with a replica kill injected mid-stream — the
+    # "survives overload and replica death" keys are measured, not claimed.
+    loadtest_result = None
+    if not opts.skip_loadtest:
+        from howtotrainyourmamlpytorch_tpu.serve.pool import (
+            PoolConfig,
+            ReplicaPool,
+        )
+        from howtotrainyourmamlpytorch_tpu.serve.resilience.replica import (
+            LocalReplica,
+        )
+        from howtotrainyourmamlpytorch_tpu.utils import faultinject
+        from tools.serve_loadtest import run_loadtest, synth_episodes
+
+        way_ = api.engine.learner.cfg.backbone.num_classes
+
+        def replica_factory(index: int) -> LocalReplica:
+            replica_api = build_api(
+                opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512
+            )
+            replica_api.engine.warmup([(way_, opts.shot, opts.query)])
+            return LocalReplica(replica_api, replica_id=f"bench-{index}")
+
+        lt_pool = ReplicaPool(
+            replica_factory,
+            PoolConfig(
+                n_replicas=2, health_interval_s=0.1,
+                restart_backoff_s=0.1, min_uptime_s=0.5,
+            ),
+        )
+        if not lt_pool.wait_ready(timeout=300.0):
+            lt_pool.close()
+            raise RuntimeError(
+                "loadtest replica pool never became healthy — a pool-boot "
+                "failure, not a serving-SLO result"
+            )
+        bb = api.engine.learner.cfg.backbone
+        lt_rate = max(2.0, round(serve_qps, 1))
+        lt_duration = max(2.0, opts.budget_s / 2)
+        faultinject.activate(
+            faultinject.FaultPlan(
+                replica_kill_at_request=max(
+                    3, int(lt_rate * lt_duration / 3)
+                )
+            )
+        )
+        try:
+            loadtest_result = run_loadtest(
+                lt_pool,
+                synth_episodes(
+                    32, way=way_, shot=opts.shot, query=opts.query,
+                    image_shape=(
+                        bb.image_channels, bb.image_height, bb.image_width,
+                    ),
+                ),
+                rate_qps=lt_rate,
+                duration_s=lt_duration,
+                p99_budget_ms=opts.slo_p99_ms,
+                error_slo=opts.error_slo,
+            )
+        finally:
+            faultinject.deactivate()
+            lt_pool.close()
+
     compile_table = api.engine.compile_table()
+    requests_offered = api.metrics.requests_total.value
     result = {
         "metric": "serve_qps",
         "value": round(serve_qps, 3),
@@ -221,7 +332,31 @@ def main(argv=None) -> int:
             "programs": len(compile_table),
             "total_traces": sum(compile_table.values()),
         },
+        # Honesty keys: the offered phases can no longer hide failures.
+        "serve_error_rate": round(
+            api.metrics.request_errors.value / requests_offered, 6
+        ) if requests_offered else 0.0,
+        "serve_errors_by_type": dict(sorted(bench_errors.items())),
+        "serve_shed_total": api.metrics.shed_total.value,
+        "serve_deadline_exceeded_total": (
+            api.metrics.deadline_exceeded_total.value
+        ),
     }
+    if loadtest_result is not None:
+        result.update(
+            {
+                "serve_slo_p99_ms": loadtest_result["serve_slo_p99_ms"],
+                "serve_loadtest_p99_ms": (
+                    loadtest_result["serve_loadtest_p99_ms"]
+                ),
+                "serve_loadtest_qps": loadtest_result["serve_loadtest_qps"],
+                "serve_loadtest_error_rate": (
+                    loadtest_result["serve_error_rate"]
+                ),
+                "serve_recovery_s": loadtest_result["serve_recovery_s"],
+                "serve_slo_pass": loadtest_result["slo_pass"],
+            }
+        )
     print(json.dumps(result))
     api.close()
     return 0

@@ -13,15 +13,25 @@ Usage::
         --config experiment_config/omniglot_maml++_omniglot_5_8_1_48_5_1.json \
         --checkpoint <experiment>/saved_models/train_model_latest \
         [--learner maml|gradient_descent|matching_nets] \
-        [--host 127.0.0.1] [--port 8080] \
+        [--host 127.0.0.1] [--port 8080] [--port_file /run/serve.port] \
         [--max_batch 4] [--max_wait_ms 2.0] [--cache_capacity 256] \
-        [--warmup 5x1x15,5x5x15] [--init_from_scratch]
+        [--max_queue_depth 64] [--degrade_queue_depth 16] \
+        [--warmup 5x1x15,5x5x15] [--init_from_scratch] \
+        [--replicas 2]
 
 Then::
 
     curl localhost:8080/healthz
     curl -d @episode.json localhost:8080/v1/episode
+    curl -d '{"checkpoint": "<path>"}' localhost:8080/admin/promote
     curl localhost:8080/metrics
+
+``--replicas N`` runs the resilience topology: N worker processes (this
+same CLI, one engine each, crash-isolated) supervised by a
+``serve/pool.ReplicaPool`` — health-checked, restarted with backoff and a
+crash-loop circuit breaker — behind one front door. ``--port 0`` binds an
+ephemeral port; ``--port_file`` announces whichever port was bound (how
+pool workers report back).
 
 ``--init_from_scratch`` serves freshly initialized weights (smoke tests,
 latency rehearsal on a cold box) instead of requiring a checkpoint.
@@ -31,7 +41,10 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import sys
+import tempfile
+import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -75,6 +88,56 @@ def build_learner(name: str, config_path: str):
     return cls(cfg)
 
 
+def build_pool(opts):
+    """The ``--replicas N`` topology: N worker subprocesses (this CLI, one
+    engine each) under pool supervision."""
+    from howtotrainyourmamlpytorch_tpu.serve.pool import (
+        PoolConfig,
+        ReplicaPool,
+    )
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.replica import (
+        SubprocessReplica,
+        serve_maml_argv,
+    )
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_dir = tempfile.mkdtemp(prefix="serve_pool_")
+
+    def factory(index: int) -> SubprocessReplica:
+        port_file = os.path.join(run_dir, f"replica_{index}.port")
+        try:
+            os.remove(port_file)
+        except OSError:
+            pass
+        argv = serve_maml_argv(
+            opts.config,
+            port_file=port_file,
+            checkpoint=opts.checkpoint,
+            learner=opts.learner,
+            warmup=opts.warmup,
+            max_batch=opts.max_batch,
+            max_wait_ms=opts.max_wait_ms,
+            cache_capacity=opts.cache_capacity,
+            max_queue_depth=opts.max_queue_depth,
+            degrade_queue_depth=opts.degrade_queue_depth,
+            max_queue_age_ms=opts.max_queue_age_ms,
+            retry_after_s=opts.retry_after_s,
+            repo_root=repo_root,
+        )
+        return SubprocessReplica(
+            argv, replica_id=f"worker-{index}", port_file=port_file
+        )
+
+    return ReplicaPool(
+        factory,
+        PoolConfig(
+            n_replicas=opts.replicas,
+            health_interval_s=opts.health_interval_s,
+            restart_backoff_s=opts.restart_backoff_s,
+        ),
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--config", required=True,
@@ -84,65 +147,125 @@ def main(argv=None) -> int:
     parser.add_argument("--learner", choices=LEARNERS, default="maml")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--port_file", default=None,
+                        help="write the bound port here once listening "
+                        "(pool workers announce their ephemeral port)")
     parser.add_argument("--max_batch", type=int, default=4)
     parser.add_argument("--max_wait_ms", type=float, default=2.0)
     parser.add_argument("--cache_capacity", type=int, default=256)
+    parser.add_argument("--max_queue_depth", type=int, default=64,
+                        help="admission hard limit: shed (503 + Retry-After)"
+                        " at this queue depth")
+    parser.add_argument("--degrade_queue_depth", type=int, default=16,
+                        help="admission soft limit: shed cold-adapt traffic "
+                        "past this depth, keep cache hits (0 disables)")
+    parser.add_argument("--max_queue_age_ms", type=float, default=2000.0)
+    parser.add_argument("--retry_after_s", type=float, default=1.0)
     parser.add_argument("--warmup", default="",
                         help="comma-separated WAYxSHOTxQUERY buckets to "
                         "pre-compile before accepting traffic")
     parser.add_argument("--init_from_scratch", action="store_true",
                         help="serve fresh init weights (no checkpoint)")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="run N supervised worker subprocesses behind "
+                        "this front door (0 = single-process)")
+    parser.add_argument("--health_interval_s", type=float, default=0.5)
+    parser.add_argument("--restart_backoff_s", type=float, default=1.0)
     opts = parser.parse_args(argv)
     if not opts.checkpoint and not opts.init_from_scratch:
         parser.error("--checkpoint is required (or pass --init_from_scratch)")
-
-    import jax
-
-    from howtotrainyourmamlpytorch_tpu.serve import (
-        ServeConfig,
-        ServingAPI,
-        make_http_server,
-    )
-
-    learner = build_learner(opts.learner, opts.config)
-    if opts.init_from_scratch:
-        state, exp_state = (
-            learner.init_inference_state(jax.random.PRNGKey(0)), {}
+    if opts.replicas > 0 and not opts.warmup:
+        # Readiness is warmup-gated: a worker that never warms answers 503
+        # on /healthz forever, the supervisor keeps it in STARTING, and the
+        # pool would deadlock with zero routable replicas. Require the
+        # operator to declare the serving buckets up front.
+        parser.error(
+            "--replicas requires --warmup WAYxSHOTxQUERY[,...]: pool "
+            "workers only become routable after warming their buckets"
         )
-    else:
-        # Learner-aware load: params+BN prefix, manifest-verified, plus any
-        # serve-time state derived from the checkpoint's recorded progress
-        # (GD recomputes its epoch-schedule fine-tune lr here).
-        state, exp_state = learner.load_inference_state(opts.checkpoint)
-    api = ServingAPI(
-        learner,
-        state,
-        ServeConfig(
-            meta_batch_size=opts.max_batch,
-            max_wait_ms=opts.max_wait_ms,
-            cache_capacity=opts.cache_capacity,
-        ),
-    )
-    if opts.warmup:
-        buckets = parse_warmup(opts.warmup)
-        print(f"warming {len(buckets)} bucket(s): {buckets}", flush=True)
-        api.engine.warmup(buckets)
 
-    server = make_http_server(api, opts.host, opts.port)
+    from howtotrainyourmamlpytorch_tpu.serve import make_http_server
+
+    if opts.replicas > 0:
+        target = build_pool(opts)
+        detail = f"{opts.replicas}-replica pool"
+    else:
+        import jax
+
+        from howtotrainyourmamlpytorch_tpu.serve import (
+            ServeConfig,
+            ServingAPI,
+        )
+
+        learner = build_learner(opts.learner, opts.config)
+        if opts.init_from_scratch:
+            state, exp_state = (
+                learner.init_inference_state(jax.random.PRNGKey(0)), {}
+            )
+        else:
+            # Learner-aware load: params+BN prefix, manifest-verified, plus
+            # any serve-time state derived from the checkpoint's recorded
+            # progress (GD recomputes its epoch-schedule fine-tune lr).
+            state, exp_state = learner.load_inference_state(opts.checkpoint)
+        target = ServingAPI(
+            learner,
+            state,
+            ServeConfig(
+                meta_batch_size=opts.max_batch,
+                max_wait_ms=opts.max_wait_ms,
+                cache_capacity=opts.cache_capacity,
+                max_queue_depth=opts.max_queue_depth,
+                degrade_queue_depth=opts.degrade_queue_depth,
+                max_queue_age_ms=opts.max_queue_age_ms,
+                retry_after_s=opts.retry_after_s,
+            ),
+        )
+        if opts.warmup:
+            buckets = parse_warmup(opts.warmup)
+            print(f"warming {len(buckets)} bucket(s): {buckets}", flush=True)
+            target.engine.warmup(buckets)
+        detail = (
+            f"{opts.learner} "
+            f"(epoch state: {exp_state.get('current_iter', 'fresh')})"
+        )
+
+    try:
+        server = make_http_server(target, opts.host, opts.port)
+    except Exception:
+        # Bind failure (EADDRINUSE, bad host) after build_pool has already
+        # spawned worker subprocesses: reap them instead of orphaning N
+        # live engines under init.
+        target.close()
+        raise
     host, port = server.server_address[:2]
+    if opts.port_file:
+        tmp = opts.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, opts.port_file)  # atomic: readers never see partial
     print(
-        f"serving {opts.learner} "
-        f"(epoch state: {exp_state.get('current_iter', 'fresh')}) "
-        f"on http://{host}:{port} — /v1/episode /healthz /metrics",
+        f"serving {detail} on http://{host}:{port} — "
+        "/v1/episode /admin/promote /healthz /metrics",
         flush=True,
     )
+
+    # SIGTERM must drain through the finally block: in pool mode the worker
+    # SUBPROCESSES are children of this front door, and dying without
+    # pool.close() would orphan N live engines (observed: kill -TERM left
+    # every worker running under init). shutdown() is called off-thread —
+    # calling it from the handler inside serve_forever would deadlock.
+    def _graceful_exit(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    previous_sigterm = signal.signal(signal.SIGTERM, _graceful_exit)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        signal.signal(signal.SIGTERM, previous_sigterm)
         server.server_close()
-        api.close()
+        target.close()
     return 0
 
 
