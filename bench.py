@@ -13,9 +13,13 @@ median timing window, see _windowed_rates), "mfu" (model-FLOPs utilization
 of the compiled train program against the chip's bf16 peak),
 "bf16_meta_iters_per_s" (the compute_dtype="bfloat16" variant), and
 "real_data_meta_iters_per_s" / "real_data_vs_baseline" (end-to-end rate
-with the real data pipeline attached; null when no datasets/ present),
-and "real_data_k25_meta_iters_per_s" (same live pipeline driven through
-the K=25 scan-dispatch mode, --iters_per_dispatch).
+with the real data pipeline attached — uint8 wire + on-device rotation +
+the device-prefetch stager, the shipped configuration; null when no
+datasets/ present), "real_data_k25_meta_iters_per_s" (same live pipeline
+driven through the K=25 scan-dispatch mode, --iters_per_dispatch), and
+"real_data_data_wait_frac" / "real_data_stage_wait_frac" (the telemetry
+stage-wait split: synthesis-blocked vs staging-blocked share of the
+per-iter window).
 """
 
 from __future__ import annotations
@@ -253,10 +257,22 @@ def _flops_per_iter(learner, state_template, batches, epoch):
 
 def _measure_real_data(seconds: float = 12.0):
     """End-to-end meta-iters/s with the REAL data pipeline (PIL-preloaded
-    Omniglot, native episode synthesis, prefetch, device transfer, per-iter
-    dispatch — exactly what the experiment loop does). Returns None when no
-    dataset is available (e.g. a fresh clone without the datasets/ link);
-    the apples-to-apples comparator is the reference's 0.55 real-data rate.
+    Omniglot, native episode synthesis, prefetch, DEVICE-SIDE STAGING, per-
+    iter dispatch — exactly what the experiment loop does). The pipeline is
+    the shipped configuration: uint8 wire, on-device rotation
+    (--device_augment) and the device-prefetch stager, so the host ships
+    raw uint8 pixels and the chip never waits on synthesis/encode/transfer
+    that overlaps compute. Returns ``(per_iter, per_chunk, data_wait_frac,
+    stage_wait_frac)`` or None when no dataset is available (e.g. a fresh
+    clone without the datasets/ link); the apples-to-apples comparator is
+    the reference's 0.55 real-data rate.
+
+    The two fractions are the telemetry stage-wait split over the per-iter
+    measurement: the share of wall time the STAGER spent blocked on episode
+    synthesis (``real_data_data_wait_frac`` — host-synthesis-bound) vs the
+    share the consumer spent blocked on a staged device buffer
+    (``real_data_stage_wait_frac`` — encode/transfer-bound), so a future
+    regression is attributable without a profiler run.
 
     All library prints are redirected to stderr so stdout keeps the
     one-JSON-line contract."""
@@ -271,8 +287,12 @@ def _measure_real_data(seconds: float = 12.0):
     ):
         return None
     try:
-        from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+        from howtotrainyourmamlpytorch_tpu.data import (
+            DevicePrefetcher,
+            MetaLearningSystemDataLoader,
+        )
         from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+        from howtotrainyourmamlpytorch_tpu.models.common import prepare_batch
         from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
             args_to_maml_config,
             get_args,
@@ -282,66 +302,97 @@ def _measure_real_data(seconds: float = 12.0):
             # Same flags the generated flagship runner script pins.
             args, _ = get_args(
                 ["--name_of_args_json_file", cfg_json,
-                 "--transfer_dtype", "uint8"]
+                 "--transfer_dtype", "uint8",
+                 "--device_augment", "True"]
             )
             learner = MAMLFewShotLearner(cfg=args_to_maml_config(args))
             state = learner.init_state(jax.random.PRNGKey(0))
             loader = MetaLearningSystemDataLoader(args=args, current_iter=0)
         epoch = 20  # steady-state program variant (past MSL horizon)
+        codec = learner.cfg.wire_codec
 
-        gen = loader.get_train_batches(total_batches=100_000, augment_images=True)
-        # Warm-up: compile + fill the prefetch queue.
-        for _ in range(3):
-            x_s, x_t, y_s, y_t, _seed = next(gen)
-            state, _ = learner.run_train_iter(state, (x_s, x_t, y_s, y_t), epoch)
-        jax.block_until_ready(state.theta)
+        def prep(host_batch):
+            return prepare_batch(host_batch, codec=codec)
 
-        # Median of REAL_DATA_WINDOWS time-boxed windows (contention
-        # rationale in _windowed_rates' docstring).
-        def step_one():
-            nonlocal state
-            x_s, x_t, y_s, y_t, _seed = next(gen)
-            state, _ = learner.run_train_iter(state, (x_s, x_t, y_s, y_t), epoch)
-            return 1
+        def staged_stream(group):
+            return DevicePrefetcher(
+                loader.get_train_batches(
+                    total_batches=100_000, augment_images=True
+                ),
+                prep,
+                group=group,
+            )
 
-        per_iter, _, _ = _windowed_rates(
-            REAL_DATA_WINDOWS,
-            _time_boxed_window(
-                seconds / REAL_DATA_WINDOWS,
-                step_one,
-                lambda: jax.block_until_ready(state.theta),
-            ),
-        )
-
-        # K-iteration scan dispatch over the same live pipeline
-        # (--iters_per_dispatch mode): amortizes per-dispatch latency, so
-        # the end-to-end rate approaches min(host synthesis, device rate).
-        # Failures here must not discard the completed per-iter result.
+        stager = staged_stream(group=1)
         try:
-            K = DISPATCH_CHUNK
-            chunk = [next(gen)[:4] for _ in range(K)]
-            state, _ = learner.run_train_iters(state, chunk, epoch)  # compile
+            # Warm-up: compile + fill the staged buffer.
+            for _ in range(3):
+                state, _ = learner.run_train_iter(state, next(stager), epoch)
             jax.block_until_ready(state.theta)
+            stager.pop_waits()  # drop the warm-up (compile-dominated) waits
 
-            def step_chunk():
+            # Median of REAL_DATA_WINDOWS time-boxed windows (contention
+            # rationale in _windowed_rates' docstring).
+            def step_one():
                 nonlocal state
-                chunk = [next(gen)[:4] for _ in range(K)]
-                state, _ = learner.run_train_iters(state, chunk, epoch)
-                return K
+                state, _ = learner.run_train_iter(state, next(stager), epoch)
+                return 1
 
-            per_chunk, _, _ = _windowed_rates(
+            t0 = time.perf_counter()
+            per_iter, _, _ = _windowed_rates(
                 REAL_DATA_WINDOWS,
                 _time_boxed_window(
                     seconds / REAL_DATA_WINDOWS,
-                    step_chunk,
+                    step_one,
                     lambda: jax.block_until_ready(state.theta),
                 ),
             )
+            measured_s = time.perf_counter() - t0
+            data_wait_s, stage_wait_s = stager.pop_waits()
+            data_wait_frac = data_wait_s / measured_s
+            stage_wait_frac = stage_wait_s / measured_s
+        finally:
+            # A failed measurement must not leave the stager thread (and
+            # its staged device buffers) alive under the bench's later
+            # measurements.
+            stager.close()
+
+        # K-iteration scan dispatch over the same live pipeline
+        # (--iters_per_dispatch mode), staged as whole dispatch groups:
+        # amortizes per-dispatch latency, so the end-to-end rate approaches
+        # min(host synthesis, device rate). Failures here must not discard
+        # the completed per-iter result.
+        try:
+            K = DISPATCH_CHUNK
+            chunk_stager = staged_stream(group=K)
+            try:
+                state, _ = learner.run_train_iters(
+                    state, next(chunk_stager), epoch
+                )  # compile
+                jax.block_until_ready(state.theta)
+
+                def step_chunk():
+                    nonlocal state
+                    state, _ = learner.run_train_iters(
+                        state, next(chunk_stager), epoch
+                    )
+                    return K
+
+                per_chunk, _, _ = _windowed_rates(
+                    REAL_DATA_WINDOWS,
+                    _time_boxed_window(
+                        seconds / REAL_DATA_WINDOWS,
+                        step_chunk,
+                        lambda: jax.block_until_ready(state.theta),
+                    ),
+                )
+            finally:
+                chunk_stager.close()
         except Exception as exc:  # noqa: BLE001 — observability extra only
             print(f"# K-dispatch real-data measurement unavailable: {exc}",
                   file=sys.stderr)
             per_chunk = None
-        return per_iter, per_chunk
+        return per_iter, per_chunk, data_wait_frac, stage_wait_frac
     except Exception as exc:  # noqa: BLE001 — observability extra only
         print(f"# real-data measurement unavailable: {exc}", file=sys.stderr)
         return None
@@ -477,7 +528,9 @@ def main() -> None:
     )
 
     real = _measure_real_data()
-    real_per_iter, real_k25 = real if real is not None else (None, None)
+    real_per_iter, real_k25, real_data_wait_frac, real_stage_wait_frac = (
+        real if real is not None else (None, None, None, None)
+    )
 
     # Telemetry overhead on the K=1 train path (telemetry/ subsystem: per-
     # dispatch step events + forced-read boundary flushes). Median of
@@ -539,6 +592,19 @@ def main() -> None:
                 ),
                 f"real_data_k{DISPATCH_CHUNK}_meta_iters_per_s": (
                     round(real_k25, 2) if real_k25 is not None else None
+                ),
+                # Telemetry stage-wait split of the per-iter real-data
+                # window: synthesis-blocked share (stager waiting on the
+                # loader) vs staging-blocked share (loop waiting on a
+                # device buffer) — regressions are attributable without a
+                # profiler run.
+                "real_data_data_wait_frac": (
+                    round(real_data_wait_frac, 4)
+                    if real_data_wait_frac is not None else None
+                ),
+                "real_data_stage_wait_frac": (
+                    round(real_stage_wait_frac, 4)
+                    if real_stage_wait_frac is not None else None
                 ),
                 # Step breakdown (PERF_NOTES.md): K-scan amortizes dispatch,
                 # K=1 pays it per iteration — the difference IS the

@@ -9,9 +9,11 @@ and per-dataset augmentation tables.
 
 from .augment import augment_image, get_transforms_for_dataset, rotate_image
 from .dataset import FewShotLearningDataset
+from .device_prefetch import DevicePrefetcher
 from .loader import MetaLearningSystemDataLoader
 
 __all__ = [
+    "DevicePrefetcher",
     "FewShotLearningDataset",
     "MetaLearningSystemDataLoader",
     "augment_image",

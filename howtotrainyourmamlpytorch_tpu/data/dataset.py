@@ -65,6 +65,9 @@ class FewShotLearningDataset:
     # __new__-safe default for fixture-driven construction; __init__ derives
     # the real value from the wire codec (--transfer_dtype uint8).
     defer_normalization = False
+    # __new__-safe default; __init__ derives the real value from
+    # --device_augment (on-device rotation / crop+flip, see get_set).
+    defer_augment = False
     """Episode synthesizer with deterministic per-index task sampling."""
 
     def __init__(self, args):
@@ -94,6 +97,17 @@ class FewShotLearningDataset:
 
         codec = wire_codec_for(args)
         self.defer_normalization = codec is not None and codec.mean is not None
+        # --device_augment: the stochastic train transforms (omniglot
+        # class-level rotation, cifar crop+flip) move into the jitted step
+        # (models/common.DeviceAugment). Episodes then ship RAW pixels plus
+        # a trailing aug payload — per-class quarter-turns for omniglot,
+        # the episode seed for cifar's keyed crop/flip. The episode RNG
+        # call ORDER is unchanged (k_list is still drawn), so class/sample
+        # selection stays bit-identical either way.
+        name = self.dataset_name.lower()
+        self.defer_augment = bool(
+            getattr(args, "device_augment", False)
+        ) and ("omniglot" in name or "cifar10" in name or "cifar100" in name)
 
         # Derived split seeds (data.py:131-142); test seed == val seed.
         val_seed = np.random.RandomState(seed=args.val_seed).randint(1, 999999)
@@ -285,12 +299,14 @@ class FewShotLearningDataset:
     def _fast_assembly_ok(self, augment_images: bool) -> bool:
         """The batched gather/rotate path applies when images are preloaded
         and the phase's transform chain draws no RNG: everything except
-        cifar's train-time random crop/flip (``data.py:80-89``)."""
+        cifar's train-time random crop/flip (``data.py:80-89``) — and with
+        ``defer_augment`` even that qualifies, since the crop/flip moves
+        into the jitted step and the host chain becomes RNG-free."""
         if not self.data_loaded_in_memory:
             return False
         name = self.dataset_name
         if "cifar10" in name or "cifar100" in name:
-            return not augment_images
+            return not augment_images or self.defer_augment
         return True
 
     def _fast_normalization(self):
@@ -370,7 +386,11 @@ class FewShotLearningDataset:
             # loop below. Preferred: the whole episode in ONE native call
             # (N class stores addressed by pointer — ctypes marshalling per
             # class was ~2/3 of the per-class path's cost).
-            rotate = augment_images and "omniglot" in self.dataset_name
+            rotate = (
+                augment_images
+                and "omniglot" in self.dataset_name
+                and not self.defer_augment
+            )
             store = self.datasets[dataset_name]
             sample_idx = np.ascontiguousarray(sample_lists, np.int64)
             ks = (
@@ -438,6 +458,7 @@ class FewShotLearningDataset:
                         dataset_name=self.dataset_name,
                         rng=aug_rng,
                         defer_normalization=self.defer_normalization,
+                        defer_augment=self.defer_augment,
                     )
                     class_image_samples.append(x)
                     class_labels.append(class_to_episode_label[class_entry])
@@ -447,13 +468,25 @@ class FewShotLearningDataset:
             x_images = np.stack(x_images)  # (N, K+T, C, H, W)
             y_labels = np.array(y_labels, dtype=np.int32)
         k = self.num_samples_per_class
-        return (
+        episode = (
             x_images[:, :k],
             x_images[:, k:],
             y_labels[:, :k],
             y_labels[:, k:],
             seed,
         )
+        if self.defer_augment and augment_images:
+            # Trailing on-device augmentation payload (consumed by the
+            # learners' DeviceAugment path, staged over the wire by
+            # prepare_batch): omniglot ships the per-class quarter-turn
+            # draw, cifar the episode seed its keyed crop/flip derives
+            # from. Eval episodes apply no augmentation and keep the plain
+            # 5-tuple.
+            if "omniglot" in self.dataset_name:
+                episode += (np.ascontiguousarray(k_list, np.int32),)
+            else:
+                episode += (np.uint32(seed % (1 << 32)),)
+        return episode
 
     # ------------------------------------------------------------------
     # Iteration contract (data.py:526-552)

@@ -1,0 +1,316 @@
+"""Device-side async prefetch: stage episode batches onto the device N
+dispatches ahead of the train loop.
+
+The real-data pipeline was ~20x slower than synthetic input (r03: 337 vs
+6,993 meta-iters/s) because three host phases ran SERIALLY with device
+compute on every step: episode synthesis (the loader queue), wire encoding
+(``prepare_batch``: uint8 codec + flatten), and the host->device transfer
+itself. ``DevicePrefetcher`` moves all three off the critical path: a
+bounded background stager thread pulls numpy batches from the existing
+loader generator, runs ``prepare_batch`` and a non-blocking
+``jax.device_put``, and parks the resulting device-resident
+:class:`~..models.common.StagedBatch` in a small buffer — so when the train
+loop asks for the next dispatch, its arrays are already on (or in flight
+to) the device and the dispatch enqueues without blocking on host work.
+
+Design contracts:
+
+* **Zero new syncs, zero new signatures.** ``device_put`` is asynchronous
+  (no forced read), and staged arrays have exactly the shapes/dtypes the
+  host path's implicit transfer would produce, so the step programs and
+  their compile signatures are unchanged (pinned under ``compile_guard`` +
+  a ``jax.device_get`` count in tests/test_device_prefetch.py).
+* **Dispatch-group staging.** ``group=K`` stages whole K-iteration scan
+  dispatches (``--iters_per_dispatch``): the K prepared batches are
+  stacked host-side and shipped as one pre-stacked tuple, the exact form
+  ``run_train_iters`` consumes. Groups never straddle an epoch boundary
+  (``epoch_len``), mirroring the builder's flush rule.
+* **Bounded device memory.** At most ``depth`` staged groups exist at any
+  time (plus the one the consumer holds). On the axon tunnel backend every
+  host->device transfer leaks its staging buffer proportionally to bytes
+  moved (PERF_NOTES.md), so deeper buffering multiplies leak rate with the
+  same wire traffic per step — the uint8 wire (``--transfer_dtype uint8``)
+  stays mandatory there, and depth stays small.
+* **Auto depth.** ``depth=AUTO_DEPTH`` starts double-buffered and deepens
+  (up to ``MAX_AUTO_DEPTH``) only when the measured stage-wait
+  distribution says the consumer keeps starving — the runtime analogue of
+  sizing from the telemetry ``data_wait`` split.
+* **Deterministic faults.** ``utils.faultinject.poison_batch`` runs on the
+  host sample inside the stager (a None-check no-op when inactive), so
+  ``nan_at_iter`` keeps poisoning the exact planned iteration.
+* **Lifecycle.** ``close()`` (idempotent; also invoked by abandoning the
+  iterator via ``with``-less ``for`` + builder rollback/preemption paths)
+  stops the thread and deletes every unconsumed staged device buffer, so
+  an abandoned mid-epoch iterator cannot pin device memory for the rest of
+  the run.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from ..models.common import StagedBatch
+from ..utils import faultinject
+
+#: ``depth`` sentinel: start at DEFAULT_DEPTH, grow to MAX_AUTO_DEPTH when
+#: the consumer's measured stage-wait says staging cannot keep up.
+AUTO_DEPTH = -1
+
+#: Double buffering: one group in flight to the device while the consumer
+#: dispatches the previous one.
+DEFAULT_DEPTH = 2
+
+#: Auto-depth ceiling: past a few groups the buffer only adds device
+#: memory (and tunnel leak exposure) without hiding more latency.
+MAX_AUTO_DEPTH = 4
+
+#: A consumer get blocked longer than this counts as a starvation sample.
+_STARVE_S = 5e-4
+
+#: Starvation samples required before auto mode deepens by one group.
+_STARVES_PER_GROWTH = 8
+
+
+class _Stop:
+    """Internal end-of-stream marker (distinct from any StagedBatch)."""
+
+
+class DevicePrefetcher:
+    """Iterator of :class:`StagedBatch` over a host episode-batch generator.
+
+    ``source``: iterator of loader samples ``(xs, xt, ys, yt, seed[, aug])``
+    (the trailing aug payload of a defer-augment loader rides into the
+    prepared batch; the seed does not cross the wire).
+    ``prepare``: the learner's codec-aware ``prepare_batch`` binding —
+    called off the critical path in the stager thread.
+    """
+
+    def __init__(
+        self,
+        source,
+        prepare,
+        depth: int = AUTO_DEPTH,
+        group: int = 1,
+        start_iter: int = 0,
+        epoch_len: int | None = None,
+    ):
+        if group < 1:
+            raise ValueError(f"group must be >= 1, got {group}")
+        self._source = source
+        self._prepare = prepare
+        self._auto = depth == AUTO_DEPTH
+        self._capacity = DEFAULT_DEPTH if self._auto else int(depth)
+        if self._capacity < 1:
+            raise ValueError(f"device prefetch depth must be >= 1, got {depth}")
+        self._group = int(group)
+        self._epoch_len = int(epoch_len) if epoch_len else None
+        self._next_iter = int(start_iter)
+
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._buffer: list = []
+        self._error: BaseException | None = None
+        self._closed = False
+        self._finished = False
+        self._data_wait_s = 0.0
+        self._stage_wait_s = 0.0
+        self._starves = 0
+        self.released_buffers = 0
+        self._thread = threading.Thread(
+            target=self._produce, name="device-prefetch-stager", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # Producer (stager thread)
+    # ------------------------------------------------------------------
+
+    def _pull_group(self):
+        """Pulls the next dispatch group of host samples, poisoned per the
+        active fault plan; respects epoch boundaries. Returns (samples,
+        first_iter) — samples may be shorter than ``group`` at the end of
+        the stream, and empty at exhaustion."""
+        first = self._next_iter
+        want = self._group
+        if self._epoch_len:
+            remaining = self._epoch_len - first % self._epoch_len
+            want = min(want, remaining)
+        samples = []
+        for j in range(want):
+            t0 = time.perf_counter()
+            try:
+                sample = next(self._source)
+            except StopIteration:
+                break
+            finally:
+                waited = time.perf_counter() - t0
+                with self._lock:
+                    self._data_wait_s += waited
+            samples.append(faultinject.poison_batch(sample, first + j))
+        self._next_iter = first + len(samples)
+        return samples, first
+
+    def _stage(self, samples, first_iter: int) -> StagedBatch:
+        """prepare_batch + stack + non-blocking device_put of one group."""
+        prepared = [
+            self._prepare((s[0], s[1], s[2], s[3], *s[5:])) for s in samples
+        ]
+        if self._group == 1 and len(prepared) == 1:
+            arrays = tuple(prepared[0])
+        else:
+            arrays = tuple(
+                np.stack([p[i] for p in prepared])
+                for i in range(len(prepared[0]))
+            )
+        return StagedBatch(
+            arrays=jax.device_put(arrays),
+            n_iters=len(samples),
+            first_iter=first_iter,
+        )
+
+    def _produce(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    while (
+                        len(self._buffer) >= self._capacity
+                        and not self._closed
+                    ):
+                        self._not_full.wait()
+                    if self._closed:
+                        return
+                samples, first = self._pull_group()
+                if not samples:
+                    break
+                staged = self._stage(samples, first)
+                with self._lock:
+                    if self._closed:
+                        self._release(staged)
+                        return
+                    self._buffer.append(staged)
+                    self._not_empty.notify()
+        except BaseException as exc:  # noqa: BLE001 — forwarded to consumer
+            with self._lock:
+                if not self._closed:
+                    self._error = exc
+        finally:
+            with self._lock:
+                self._finished = True
+                self._not_empty.notify_all()
+
+    # ------------------------------------------------------------------
+    # Consumer
+    # ------------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> StagedBatch:
+        t0 = time.perf_counter()
+        with self._not_empty:
+            while not self._buffer and not self._finished and not self._closed:
+                self._not_empty.wait()
+            waited = time.perf_counter() - t0
+            self._stage_wait_s += waited
+            if self._buffer:
+                staged = self._buffer.pop(0)
+                self._maybe_deepen(waited)
+                self._not_full.notify()
+                return staged
+            if self._error is not None:
+                error, self._error = self._error, None
+                raise error
+            raise StopIteration
+
+    def _maybe_deepen(self, waited: float) -> None:
+        """Auto-depth growth, called under the lock: repeated consumer
+        starvation means the current depth cannot absorb the staging
+        latency variance — deepen one group at a time up to the ceiling."""
+        if not self._auto or self._capacity >= MAX_AUTO_DEPTH:
+            return
+        if waited >= _STARVE_S:
+            self._starves += 1
+            if self._starves >= _STARVES_PER_GROWTH:
+                self._starves = 0
+                self._capacity += 1
+                self._not_full.notify()
+
+    @property
+    def depth(self) -> int:
+        """Current staged-group capacity (grows in auto mode)."""
+        return self._capacity
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def pop_waits(self) -> tuple[float, float]:
+        """Returns and resets ``(data_wait_s, stage_wait_s)`` accumulated
+        since the previous call: seconds the STAGER spent blocked pulling
+        host batches from the loader (episode synthesis is the bottleneck)
+        vs seconds the CONSUMER spent blocked waiting for a staged group
+        (encode/transfer staging is the bottleneck). Sampled once per
+        dispatch by the trainer — the two-way split that makes a slow host
+        synthesizer distinguishable from a slow wire in the step-time
+        breakdown."""
+        with self._lock:
+            waits = (self._data_wait_s, self._stage_wait_s)
+            self._data_wait_s = self._stage_wait_s = 0.0
+        return waits
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def _release(self, staged: StagedBatch) -> None:
+        """Frees one staged group's device buffers immediately (instead of
+        waiting for GC — the buffers may be the only live references)."""
+        for leaf in staged.arrays:
+            try:
+                leaf.delete()
+            except Exception:  # noqa: BLE001 — already-deleted / np fallback
+                pass
+        self.released_buffers += 1
+
+    def close(self) -> None:
+        """Stops the stager thread and deletes every unconsumed staged
+        device buffer. Idempotent; safe from any thread. MUST be called
+        when an iteration is abandoned mid-stream (rollback, preemption,
+        early break) — an abandoned stager would otherwise pin up to
+        ``depth`` dispatch groups of device memory for the rest of the
+        process."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+        # Short join only: a producer parked inside ``next(source)`` (empty
+        # loader queue) cannot be interrupted by the flag, and the
+        # preemption/rollback shutdown paths that call close() must not
+        # stall behind it. A still-live producer is a daemon that checks
+        # ``_closed`` right after its blocking call returns and releases
+        # anything it staged meanwhile — safe to leave winding down.
+        self._thread.join(timeout=2.0)
+        with self._lock:
+            buffered, self._buffer = list(self._buffer), []
+        for staged in buffered:
+            self._release(staged)
+        if not self._thread.is_alive():
+            # The generator is no longer executing in the stager thread;
+            # close it so the loader's own machinery can wind down too.
+            try:
+                self._source.close()
+            except (AttributeError, RuntimeError):
+                pass
+
+    def __del__(self):  # best-effort: explicit close() is the contract
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

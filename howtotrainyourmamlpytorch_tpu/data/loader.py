@@ -41,15 +41,14 @@ class _ProducerError:
 
 
 def _collate_episodes(episodes):
-    """Stacks per-episode ``(xs, xt, ys, yt, seed)`` tuples into batch
-    arrays."""
-    xs, xt, ys, yt, seeds = zip(*episodes)
-    return (
-        np.stack(xs),
-        np.stack(xt),
-        np.stack(ys),
-        np.stack(yt),
-        np.asarray(seeds),
+    """Stacks per-episode ``(xs, xt, ys, yt, seed[, aug])`` tuples into
+    batch arrays. The optional trailing element is the on-device
+    augmentation payload of a defer-augment dataset (``--device_augment``):
+    per-class rotation draws ``(N,)`` or a scalar episode seed — collated
+    to ``(B, N)`` / ``(B,)`` alongside the image stacks."""
+    columns = list(zip(*episodes))
+    return tuple(np.stack(c) for c in columns[:4]) + tuple(
+        np.asarray(c) for c in columns[4:]
     )
 
 

@@ -34,6 +34,8 @@ import time
 import jax
 import numpy as np
 
+from .data.device_prefetch import AUTO_DEPTH, DevicePrefetcher
+from .models.common import StagedBatch, prepare_batch
 from .telemetry import TrainTelemetry
 from .utils import faultinject
 from .utils.checkpoint import CheckpointCorruptError, publish_alias
@@ -172,6 +174,14 @@ class ExperimentBuilder:
         self._use_multi = self.iters_per_dispatch > 1 and hasattr(
             self.model, "run_train_iters"
         )
+        # Device-side async prefetch (data/device_prefetch.py): a stager
+        # thread runs prepare_batch + non-blocking device_put N dispatch
+        # groups ahead, overlapping episode synthesis, wire encoding and
+        # the host->device transfer with device compute. -1 = auto depth
+        # (double-buffered, deepening from the measured stage-wait), 0 =
+        # off (inline host prep, the pre-stager path), N = pinned depth.
+        self.device_prefetch = int(getattr(args, "device_prefetch", -1))
+        self._stager = None
         # Observability (SURVEY §5 tracing row — the reference has none):
         # the unified telemetry subsystem (telemetry/). Structured run
         # events in logs/telemetry.jsonl (per-dispatch step-time breakdown
@@ -570,13 +580,22 @@ class ExperimentBuilder:
 
     def _record_dispatch(self, n_iters: int = 1, upto_iter: int = 0) -> None:
         """One completed device dispatch ending at ``upto_iter``: samples
-        the loader's blocked-in-``next`` time (the data-wait share of the
-        step) and hands both to the telemetry recorder. Metrics stay lazy —
-        no device sync."""
-        pop_wait = getattr(self.data, "pop_data_wait", None)
-        data_wait_s = float(pop_wait()) if pop_wait is not None else 0.0
+        the host-wait split and hands it to the telemetry recorder. With
+        the stager active the split is two-way — synthesis wait (stager
+        blocked on the loader, OFF the critical path) vs stage wait (the
+        loop blocked on a staged device buffer); without it, the loader's
+        blocked-in-``next`` time is the consumer-blocking data wait exactly
+        as before. Metrics stay lazy — no device sync."""
+        if self._stager is not None:
+            data_wait_s, stage_wait_s = self._stager.pop_waits()
+            staged = True
+        else:
+            pop_wait = getattr(self.data, "pop_data_wait", None)
+            data_wait_s = float(pop_wait()) if pop_wait is not None else 0.0
+            stage_wait_s, staged = 0.0, False
         self.telemetry.record_dispatch(
-            upto_iter, n_iters=n_iters, data_wait_s=data_wait_s
+            upto_iter, n_iters=n_iters, data_wait_s=data_wait_s,
+            stage_wait_s=stage_wait_s, staged=staged,
         )
 
     # ------------------------------------------------------------------
@@ -585,11 +604,19 @@ class ExperimentBuilder:
 
     def train_iteration(self, train_sample, sample_idx, epoch_idx, total_losses,
                         current_iter):
-        x_support, x_target, y_support, y_target, _seed = train_sample
-        data_batch = (x_support, x_target, y_support, y_target)
+        if isinstance(train_sample, StagedBatch):
+            # Device-resident group from the stager: already prepared (and
+            # poisoned, if a fault plan is active) — hand it straight to
+            # the learner.
+            data_batch = train_sample
+            shapes = [a.shape for a in train_sample.arrays[:4]]
+        else:
+            # Loader sample: (xs, xt, ys, yt, seed[, aug]) — the seed stays
+            # on the host, the trailing device-augment payload rides along.
+            data_batch = tuple(train_sample[:4]) + tuple(train_sample[5:])
+            shapes = [a.shape for a in train_sample[:4]]
         if sample_idx == 0:
-            print("shape of data", x_support.shape, x_target.shape,
-                  y_support.shape, y_target.shape)
+            print("shape of data", *shapes)
 
         self.train_state, losses = self.model.run_train_iter(
             self.train_state, data_batch, epoch=epoch_idx
@@ -623,18 +650,22 @@ class ExperimentBuilder:
     def train_iteration_multi(self, samples, epoch_idx, total_losses, current_iter):
         """K iterations in one dispatch (``run_train_iters``); appends the
         chunk's full ``(K,)`` per-iteration metrics, so epoch summaries have
-        one sample per meta-update at any ``--iters_per_dispatch``."""
-        batches = [(s[0], s[1], s[2], s[3]) for s in samples]
+        one sample per meta-update at any ``--iters_per_dispatch``.
+        ``samples`` is a list of loader samples, or one pre-staged
+        ``StagedBatch`` dispatch group from the device prefetcher."""
+        if isinstance(samples, StagedBatch):
+            n_iters, batches = samples.n_iters, samples
+        else:
+            n_iters = len(samples)
+            batches = [tuple(s[:4]) + tuple(s[5:]) for s in samples]
         self.train_state, losses = self.model.run_train_iters(
             self.train_state, batches, epoch=epoch_idx
         )
-        self._record_dispatch(
-            len(samples), upto_iter=current_iter + len(samples)
-        )
+        self._record_dispatch(n_iters, upto_iter=current_iter + n_iters)
         for key, value in losses.items():
             total_losses.setdefault(key, []).append(value)
-        current_iter += len(samples)
-        if _multi_log_due(current_iter, len(samples)):
+        current_iter += n_iters
+        if _multi_log_due(current_iter, n_iters):
             t_sync = time.perf_counter()
             self._sentinel_check(losses, current_iter)
             summary = self.build_loss_summary_string(losses)
@@ -814,17 +845,92 @@ class ExperimentBuilder:
                 self._perform_rollback(trip)
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
 
+    def _make_stager(self, batches) -> "DevicePrefetcher | None":
+        """Wraps a fresh train-batch generator in the device prefetcher
+        (``--device_prefetch``; 0 disables). Dispatch groups match the
+        builder's own chunking: ``iters_per_dispatch`` on the K-scan path,
+        single batches otherwise, never straddling an epoch boundary."""
+        if self.device_prefetch == 0:
+            return None
+        if getattr(self.model, "mesh", None) is not None:
+            # Sharded runs pin in_shardings on the step programs; the
+            # stager's bare device_put would commit staged arrays to one
+            # device and either trip a committed-device mismatch or insert
+            # a reshard copy on the critical path. Mesh-aware staging
+            # (device_put with the batch sharding) is follow-up work — the
+            # multichip path keeps the inline host loop for now.
+            return None
+        codec = getattr(self.model.cfg, "wire_codec", None)
+
+        def prepare(host_batch):
+            return prepare_batch(host_batch, codec=codec)
+
+        return DevicePrefetcher(
+            batches,
+            prepare,
+            depth=(
+                self.device_prefetch if self.device_prefetch > 0
+                else AUTO_DEPTH
+            ),
+            group=self.iters_per_dispatch if self._use_multi else 1,
+            start_iter=int(self.state["current_iter"]),
+            epoch_len=int(self.args.total_iter_per_epoch),
+        )
+
     def _train_until_rollback(self, total_iters):
         """One pass of the train loop over a fresh batch generator; unwinds
         with ``_RollbackSignal`` when the divergence sentinel trips under the
-        ``rollback`` policy (the outer loop reloads and re-enters)."""
+        ``rollback`` policy (the outer loop reloads and re-enters).
+
+        With the device prefetcher active (the default) the generator is
+        consumed by the stager thread, which ships prepared, device-resident
+        dispatch groups; the loop body only dispatches and runs the epoch
+        machinery. The stager is closed on EVERY exit from this frame —
+        epoch-pause ``sys.exit``, preemption-requeue, rollback unwind,
+        crash — so an abandoned mid-epoch iteration can never leak the
+        stager thread or its staged device buffers."""
+        batches = self.data.get_train_batches(
+            total_batches=total_iters - self.state["current_iter"],
+            augment_images=self.augment_flag,
+        )
+        stager = self._make_stager(batches)
+        if stager is None:
+            self._train_loop_host(batches)
+            return
+        self._stager = stager
+        try:
+            for staged in stager:
+                epoch_idx = (
+                    self.state["current_iter"]
+                    / self.args.total_iter_per_epoch
+                )
+                if self._use_multi:
+                    (self.total_losses,
+                     self.state["current_iter"]) = self.train_iteration_multi(
+                        samples=staged,
+                        epoch_idx=epoch_idx,
+                        total_losses=self.total_losses,
+                        current_iter=self.state["current_iter"],
+                    )
+                else:
+                    (self.total_losses,
+                     self.state["current_iter"]) = self.train_iteration(
+                        train_sample=staged,
+                        sample_idx=self.state["current_iter"],
+                        epoch_idx=epoch_idx,
+                        total_losses=self.total_losses,
+                        current_iter=self.state["current_iter"],
+                    )
+                self._post_dispatch_boundary()
+        finally:
+            self._stager = None
+            stager.close()
+
+    def _train_loop_host(self, batches):
+        """The ``--device_prefetch 0`` loop: host samples consumed inline,
+        chunk-buffered for the K-scan path — the pre-stager behavior."""
         buffered = []
-        for train_sample_idx, train_sample in enumerate(
-            self.data.get_train_batches(
-                total_batches=total_iters - self.state["current_iter"],
-                augment_images=self.augment_flag,
-            )
-        ):
+        for train_sample in batches:
             if self._use_multi:
                 buffered.append(train_sample)
                 next_iter = self.state["current_iter"] + len(buffered)
@@ -858,93 +964,99 @@ class ExperimentBuilder:
                     total_losses=self.total_losses,
                     current_iter=self.state["current_iter"],
                 )
+            self._post_dispatch_boundary()
 
-            if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
-                # The epoch summary is the big forced read of the loop
-                # (every accumulated device scalar); its wall time is the
-                # epoch-boundary host-sync sample of the step breakdown.
-                t_sync = time.perf_counter()
-                train_losses = self.build_summary_dict(
-                    self.total_losses, phase="train"
-                )
-                epoch_sync_s = time.perf_counter() - t_sync
-                train_losses.update(
-                    self.telemetry.epoch_stats("train", epoch=self.epoch)
-                )
-                self.telemetry.boundary(
-                    self.state["current_iter"], epoch_sync_s,
-                    reason="epoch_summary",
-                )
-                # Epoch-boundary sentinel: runs BEFORE validation and
-                # checkpointing, so a poisoned epoch can neither waste a
-                # val pass (halt/rollback) nor reach a checkpoint.
-                self._sentinel_epoch_boundary(train_losses)
-                total_losses = {}
-                num_val_batches = int(
-                    self.args.num_evaluation_tasks / self.args.batch_size
-                )
-                for val_sample in self.data.get_val_batches(
-                    total_batches=num_val_batches, augment_images=False
-                ):
-                    total_losses = self.evaluation_iteration(
-                        val_sample=val_sample, total_losses=total_losses,
-                        phase="val",
-                    )
-                val_losses = self.build_summary_dict(total_losses, phase="val")
-                # GD's eval mutates the persisted state: check val trips
-                # before best-val tracking and checkpointing too.
-                self._sentinel_epoch_boundary(val_losses)
-                if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
-                    print("Best validation accuracy",
-                          val_losses["val_accuracy_mean"])
-                    self.state["best_val_acc"] = val_losses["val_accuracy_mean"]
-                    self.state["best_val_iter"] = self.state["current_iter"]
-                    self.state["best_epoch"] = int(
-                        self.state["best_val_iter"]
-                        / self.args.total_iter_per_epoch
-                    )
+    def _post_dispatch_boundary(self) -> None:
+        """Everything that runs after a completed dispatch: the epoch
+        boundary (summary, validation, checkpoint, pause) when the
+        iteration count crossed one, then the preemption check — AFTER the
+        epoch block, so a signal landing on a boundary dispatch still gets
+        its val epoch + epoch checkpoint + stats row before the exit (a
+        mid-epoch emergency resume cannot reconstruct those)."""
+        if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
+            self._run_epoch_boundary()
+        faultinject.sigterm_due(self.state["current_iter"])
+        self._maybe_emergency_exit()
 
-                self.epoch += 1
-                self.state = self.merge_two_dicts(
-                    self.merge_two_dicts(self.state, train_losses), val_losses
-                )
-                # Metrics are packed BEFORE checkpointing — a deliberate
-                # fix of the reference's ordering (:350 vs :352), where
-                # the epoch-N checkpoint misses epoch N's stats row, so a
-                # resume loses it and silently shifts the
-                # ensemble's val-stats-index -> checkpoint mapping.
-                self.start_time, self.state = self.pack_and_save_metrics(
-                    start_time=self.start_time,
-                    create_summary_csv=self.create_summary_csv,
-                    train_losses=train_losses,
-                    val_losses=val_losses,
-                    state=self.state,
-                )
-                self.save_models(model=self.model, epoch=self.epoch,
-                                 state=self.state)
-                self.total_losses = {}
-                self.epochs_done_in_this_run += 1
-                save_to_json(
-                    filename=os.path.join(self.logs_filepath,
-                                          "summary_statistics.json"),
-                    dict_to_store=self.state["per_epoch_statistics"],
-                )
-                # Flush the checkpoint-save/alias events the epoch publish
-                # just emitted (still a forced-read boundary, zero new
-                # syncs).
-                self.telemetry.flush()
-                if self.epochs_done_in_this_run >= self.total_epochs_before_pause:
-                    print(
-                        "train_seed {}, val_seed: {}, at pause time".format(
-                            self.data.dataset.seed["train"],
-                            self.data.dataset.seed["val"],
-                        )
-                    )
-                    sys.exit()
+    def _run_epoch_boundary(self) -> None:
+        # The epoch summary is the big forced read of the loop
+        # (every accumulated device scalar); its wall time is the
+        # epoch-boundary host-sync sample of the step breakdown.
+        t_sync = time.perf_counter()
+        train_losses = self.build_summary_dict(
+            self.total_losses, phase="train"
+        )
+        epoch_sync_s = time.perf_counter() - t_sync
+        train_losses.update(
+            self.telemetry.epoch_stats("train", epoch=self.epoch)
+        )
+        self.telemetry.boundary(
+            self.state["current_iter"], epoch_sync_s,
+            reason="epoch_summary",
+        )
+        # Epoch-boundary sentinel: runs BEFORE validation and
+        # checkpointing, so a poisoned epoch can neither waste a
+        # val pass (halt/rollback) nor reach a checkpoint.
+        self._sentinel_epoch_boundary(train_losses)
+        total_losses = {}
+        num_val_batches = int(
+            self.args.num_evaluation_tasks / self.args.batch_size
+        )
+        for val_sample in self.data.get_val_batches(
+            total_batches=num_val_batches, augment_images=False
+        ):
+            total_losses = self.evaluation_iteration(
+                val_sample=val_sample, total_losses=total_losses,
+                phase="val",
+            )
+        val_losses = self.build_summary_dict(total_losses, phase="val")
+        # GD's eval mutates the persisted state: check val trips
+        # before best-val tracking and checkpointing too.
+        self._sentinel_epoch_boundary(val_losses)
+        if val_losses["val_accuracy_mean"] > self.state["best_val_acc"]:
+            print("Best validation accuracy",
+                  val_losses["val_accuracy_mean"])
+            self.state["best_val_acc"] = val_losses["val_accuracy_mean"]
+            self.state["best_val_iter"] = self.state["current_iter"]
+            self.state["best_epoch"] = int(
+                self.state["best_val_iter"]
+                / self.args.total_iter_per_epoch
+            )
 
-            # Preemption boundary: AFTER the epoch-boundary block, so a
-            # signal landing on a boundary dispatch still gets its val
-            # epoch + epoch checkpoint + stats row before the exit (a
-            # mid-epoch emergency resume cannot reconstruct those).
-            faultinject.sigterm_due(self.state["current_iter"])
-            self._maybe_emergency_exit()
+        self.epoch += 1
+        self.state = self.merge_two_dicts(
+            self.merge_two_dicts(self.state, train_losses), val_losses
+        )
+        # Metrics are packed BEFORE checkpointing — a deliberate
+        # fix of the reference's ordering (:350 vs :352), where
+        # the epoch-N checkpoint misses epoch N's stats row, so a
+        # resume loses it and silently shifts the
+        # ensemble's val-stats-index -> checkpoint mapping.
+        self.start_time, self.state = self.pack_and_save_metrics(
+            start_time=self.start_time,
+            create_summary_csv=self.create_summary_csv,
+            train_losses=train_losses,
+            val_losses=val_losses,
+            state=self.state,
+        )
+        self.save_models(model=self.model, epoch=self.epoch,
+                         state=self.state)
+        self.total_losses = {}
+        self.epochs_done_in_this_run += 1
+        save_to_json(
+            filename=os.path.join(self.logs_filepath,
+                                  "summary_statistics.json"),
+            dict_to_store=self.state["per_epoch_statistics"],
+        )
+        # Flush the checkpoint-save/alias events the epoch publish
+        # just emitted (still a forced-read boundary, zero new
+        # syncs).
+        self.telemetry.flush()
+        if self.epochs_done_in_this_run >= self.total_epochs_before_pause:
+            print(
+                "train_seed {}, val_seed: {}, at pause time".format(
+                    self.data.dataset.seed["train"],
+                    self.data.dataset.seed["val"],
+                )
+            )
+            sys.exit()

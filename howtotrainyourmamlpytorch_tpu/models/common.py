@@ -146,11 +146,22 @@ def encode_images(x: np.ndarray, codec: WireCodec) -> np.ndarray:
     or transform that violates the k/scale invariant would corrupt images
     without an error. Clipping bounds the damage; exactness for in-range
     values is unchanged (rint of in-range k stays k).
+
+    Allocation discipline: ONE float32 scratch (scale/rint/clip run in
+    place on it) plus the uint8 output — the expression form
+    ``clip(rint(x*scale)).astype(u8)`` materialized up to four temporaries
+    per image tensor, which dominated ``prepare_batch`` host time at the
+    flagship batch shapes (PERF_NOTES.md "Episode-synthesis host
+    pipeline").
     """
     x = np.asarray(x)
     if codec.scale != 1.0:
-        x = x * np.float32(codec.scale)
-    return np.clip(np.rint(x), 0.0, 255.0).astype(np.uint8)
+        scratch = np.multiply(x, np.float32(codec.scale), dtype=np.float32)
+        np.rint(scratch, out=scratch)
+    else:
+        scratch = np.rint(np.asarray(x, np.float32))
+    np.clip(scratch, 0.0, 255.0, out=scratch)
+    return scratch.astype(np.uint8)
 
 
 def decode_images(x, codec: WireCodec | None, dtype):
@@ -205,8 +216,14 @@ def prepare_batch(data_batch, codec: WireCodec | None = None):
     """(B, N, K, C, H, W) numpy episode batch -> flattened device-ready
     arrays, mirroring the reference's ``view(-1, c, h, w)``
     (``few_shot_learning_system.py:208-213``). With ``codec`` the image
-    arrays go over the wire as uint8 (see WireCodec)."""
-    xs, xt, ys, yt = data_batch
+    arrays go over the wire as uint8 (see WireCodec).
+
+    An optional fifth element is the on-device augmentation operand (the
+    ``DeviceAugment`` payload a defer-augment loader ships beside the raw
+    pixels: omniglot per-class quarter-turns ``(B, N)`` int32, or cifar
+    per-episode seeds ``(B,)`` uint32); it rides through unchanged as the
+    prepared batch's fifth array."""
+    xs, xt, ys, yt, *aug = data_batch
     if codec is not None:
         xs, xt = encode_images(xs, codec), encode_images(xt, codec)
     else:
@@ -215,7 +232,161 @@ def prepare_batch(data_batch, codec: WireCodec | None = None):
     b = xs.shape[0]
     xs = xs.reshape(b, -1, *xs.shape[-3:])
     xt = xt.reshape(b, -1, *xt.shape[-3:])
-    return xs, xt, ys.reshape(b, -1), yt.reshape(b, -1)
+    out = (xs, xt, ys.reshape(b, -1), yt.reshape(b, -1))
+    if aug:
+        out += (np.asarray(aug[0]),)
+    return out
+
+
+class StagedBatch(NamedTuple):
+    """A dispatch group staged onto the device ahead of time by
+    ``data/device_prefetch.DevicePrefetcher``.
+
+    ``arrays`` holds device-resident arrays in ``prepare_batch`` layout —
+    for ``n_iters == 1`` the single-dispatch tuple, for ``n_iters == K``
+    the pre-stacked form with a leading K axis (what ``run_train_iters``
+    scans over). Learners accept a ``StagedBatch`` anywhere they accept a
+    host episode batch and skip their own ``prepare_batch`` (the stager
+    already ran it off the critical path); the wire signature is identical
+    to the host path, so staging mints no new compile signatures."""
+
+    arrays: tuple
+    n_iters: int
+    first_iter: int
+
+
+class DeviceAugment(NamedTuple):
+    """Static spec of the on-device (in-step) episode augmentation.
+
+    ``kind``:
+
+    * ``"rot90"`` — omniglot's class-level k*90-degree rotation, applied as
+      a 4-variant gather inside the jitted step (``rot90_by_gather``).
+      BIT-EXACT vs the host transform: a rotation is pure data movement,
+      so rotating 0/1 pixels is exact in any dtype — this extends the
+      uint8-wire bit-exactness contract (tests/test_wire_codec.py).
+    * ``"crop_flip"`` — cifar's 4px-pad random crop + horizontal flip,
+      drawn on-device from a per-episode PRNG key (``crop_flip_by_key``).
+      Distribution-equivalent to the host transform (same offset/flip
+      laws), not stream-identical — the reference's own crop/flip streams
+      are irreproducible anyway (they draw from global torch RNG).
+
+    With augmentation in the step, the host ships RAW uint8 pixels plus a
+    tiny aug operand, so episode synthesis does no per-image rotation or
+    crop work at all."""
+
+    kind: str
+    pad: int = 4
+
+
+def rot90_by_gather(x, ks):
+    """Class-level k*90-degree rotation of ONE task's images, inside jit.
+
+    ``x``: ``(M, C, H, W)`` images, class-major with ``M = N * S`` (``S``
+    samples per class); ``ks``: ``(N,)`` int32 quarter-turns per class
+    (the episode RNG's ``randint(0, 4)`` draw, shipped over the wire).
+    ``jnp.rot90`` needs a static k, so all four variants are materialized
+    (pure data movement) and a gather selects per sample — exact in any
+    dtype. Requires H == W (omniglot is square)."""
+    n = ks.shape[0]
+    samples_per_class = x.shape[0] // n
+    variants = jnp.stack(
+        [x if k == 0 else jnp.rot90(x, k=k, axes=(-2, -1)) for k in range(4)]
+    )
+    per_sample = jnp.repeat(ks.astype(jnp.int32), samples_per_class)
+    return variants[per_sample, jnp.arange(x.shape[0])]
+
+
+def crop_flip_by_key(x, seed, pad: int, stream: int):
+    """Per-episode-keyed random crop (``pad`` px zero padding) + horizontal
+    flip of ONE task's images, inside jit — torchvision
+    ``RandomCrop(size, padding)`` + ``RandomHorizontalFlip`` laws, drawn
+    from ``jax.random`` keyed by the episode seed. ``stream`` separates the
+    support draw from the target draw (host augmentation draws per image
+    across the whole episode; on device the two arrays are transformed
+    independently, so each needs its own fold).
+
+    MUST run in raw-pixel space (before deferred normalization): the host
+    transform pads with literal zeros before normalizing, so padding after
+    normalization would inject the wrong constant."""
+    m, c, h, w = x.shape
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), stream)
+    k_off, k_flip = jax.random.split(key)
+    offs = jax.random.randint(k_off, (m, 2), 0, 2 * pad + 1)
+    flips = jax.random.bernoulli(k_flip, 0.5, (m,))
+    padded = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+
+    def crop_one(img, off):
+        return jax.lax.dynamic_slice(img, (0, off[0], off[1]), (c, h, w))
+
+    cropped = jax.vmap(crop_one)(padded, offs)
+    return jnp.where(flips[:, None, None, None], cropped[..., ::-1], cropped)
+
+
+def decode_augment_images(
+    x,
+    codec: WireCodec | None,
+    dtype,
+    augment: "DeviceAugment | None" = None,
+    aug=None,
+    stream: int = 0,
+):
+    """Wire decode + on-device train augmentation for ONE task's images.
+
+    Without ``augment``/``aug`` this is exactly ``decode_images``; eval
+    batches never carry an aug operand, so their programs are untouched.
+    ``rot90`` commutes with the elementwise decode and runs after it;
+    ``crop_flip`` must interleave (descale -> crop/flip in raw pixel space
+    -> normalize), matching the host order crop -> flip -> normalize."""
+    if augment is None or aug is None:
+        return decode_images(x, codec, dtype)
+    if augment.kind == "rot90":
+        return rot90_by_gather(decode_images(x, codec, dtype), aug)
+    if augment.kind != "crop_flip":
+        raise ValueError(f"unknown device augmentation kind {augment.kind!r}")
+    if codec is None or codec.mean is None:
+        raise ValueError(
+            "crop_flip device augmentation requires the deferred-"
+            "normalization uint8 wire codec (--transfer_dtype uint8): the "
+            "host otherwise ships normalized pixels, and zero-padding them "
+            "diverges from the reference's pad-before-normalize order"
+        )
+    x = x.astype(jnp.float32) / jnp.float32(codec.scale)
+    x = crop_flip_by_key(x, aug, augment.pad, stream)
+    mean = jnp.asarray(codec.mean, jnp.float32).reshape(-1, 1, 1)
+    std = jnp.asarray(codec.std, jnp.float32).reshape(-1, 1, 1)
+    return ((x - mean) / std).astype(dtype)
+
+
+def decode_train_batch(batch, codec: WireCodec | None, dtype, augment=None):
+    """Batch-level wire decode + on-device train augmentation for learners
+    that decode the whole ``(B, M, C, H, W)`` batch before their task scan
+    (gradient descent, matching nets; MAML decodes per task inside its
+    vmap). ``batch`` is a prepared 4-tuple, or 5-tuple with the trailing
+    per-task aug operand. Returns ``(xs, xt, ys, yt)`` decoded (and
+    augmented when both ``augment`` and the operand are present)."""
+    xs, xt, ys, yt, *aug = batch
+    if augment is None or not aug:
+        return (
+            decode_images(xs, codec, dtype),
+            decode_images(xt, codec, dtype),
+            ys,
+            yt,
+        )
+    operand = aug[0]
+
+    def dec(stream):
+        def apply(x, a):
+            return decode_augment_images(x, codec, dtype, augment, a, stream)
+
+        return apply
+
+    return (
+        jax.vmap(dec(0))(xs, operand),
+        jax.vmap(dec(1))(xt, operand),
+        ys,
+        yt,
+    )
 
 
 class InferenceState(NamedTuple):

@@ -38,8 +38,10 @@ from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
     InferenceState,
+    StagedBatch,
     cosine_epoch_lr,
     decode_images,
+    decode_train_batch,
     guard_nonfinite_update,
     make_injected_adam,
     named_partial,
@@ -125,10 +127,13 @@ class GradientDescentLearner(CheckpointableLearner):
                    training: bool = True):
         """One meta-iteration: sequentially fine-tune over each task."""
         backbone = self.backbone
-        xs_b, xt_b, ys_b, yt_b = batch
-        # uint8 wire decode (cast / descale / normalize) — see WireCodec.
-        xs_b = decode_images(xs_b, self.cfg.wire_codec, jnp.float32)
-        xt_b = decode_images(xt_b, self.cfg.wire_codec, jnp.float32)
+        # uint8 wire decode (cast / descale / normalize, plus the on-device
+        # train augmentation when the batch carries an aug operand) — see
+        # WireCodec / DeviceAugment in models/common.
+        xs_b, xt_b, ys_b, yt_b = decode_train_batch(
+            batch, self.cfg.wire_codec, jnp.float32,
+            self.cfg.device_augment if training else None,
+        )
 
         def task_fn(carry, task):
             theta, bn, opt_state = carry
@@ -190,7 +195,11 @@ class GradientDescentLearner(CheckpointableLearner):
     def run_train_iter(self, state: GDState, data_batch, epoch):
         epoch = int(epoch)
         self.current_epoch = epoch
-        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else prepare_batch(data_batch, codec=self.cfg.wire_codec)
+        )
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
         new_state, metrics, _ = self._train_step(state, batch)

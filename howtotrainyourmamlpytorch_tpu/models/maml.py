@@ -48,8 +48,11 @@ from ..utils.trees import merge, partition
 from .backbone import BackboneConfig, build_backbone
 from .common import (
     CheckpointableLearner,
+    DeviceAugment,
+    StagedBatch,
     WireCodec,
     cosine_epoch_lr,
+    decode_augment_images,
     decode_images,
     guard_nonfinite_update,
     named_partial,
@@ -108,6 +111,12 @@ class MAMLConfig:
     # transfer bandwidth AND 4x slower axon-tunnel staging-buffer leak
     # (PERF_NOTES.md), bit-exact for the datasets that opt in.
     wire_codec: "WireCodec | None" = None
+    # On-device train augmentation (--device_augment): the train batch
+    # carries a trailing aug operand and the step applies the transform
+    # in-program (omniglot rot90-by-gather is bit-exact vs the host
+    # transform; cifar crop/flip is per-episode-keyed). The host then ships
+    # raw uint8 pixels only — see models/common.DeviceAugment.
+    device_augment: "DeviceAugment | None" = None
 
     @property
     def dtype(self):
@@ -354,16 +363,22 @@ class MAMLFewShotLearner(CheckpointableLearner):
         """The exact ``(step_fn, stacked_batches, importance)`` that
         ``run_train_iters`` executes for this epoch — single source of truth
         for the program-variant selection (second order, MSL final-only)."""
-        # Pre-stacked form: exactly 4 array-likes (np or device arrays).
-        # A sequence of episode batches has tuples as elements instead.
-        if len(data_batches) == 4 and all(
+        # StagedBatch: the device-prefetch stager already prepared, stacked
+        # and device_put the whole dispatch group (data/device_prefetch.py).
+        if isinstance(data_batches, StagedBatch):
+            batches = tuple(data_batches.arrays)
+        # Pre-stacked form: exactly 4 (or 5 with the device-augment
+        # operand) array-likes. A sequence of episode batches has tuples
+        # as elements instead.
+        elif len(data_batches) in (4, 5) and all(
             hasattr(b, "ndim") for b in data_batches
         ):
             batches = tuple(data_batches)
         else:
             prepared = [self._prepare_batch(b) for b in data_batches]
             batches = tuple(
-                np.stack([p[i] for p in prepared]) for i in range(4)
+                np.stack([p[i] for p in prepared])
+                for i in range(len(prepared[0]))
             )
         importance = self._train_importance(epoch)
         final_only = not (
@@ -478,8 +493,9 @@ class MAMLFewShotLearner(CheckpointableLearner):
         x_target: jax.Array,
         y_target: jax.Array,
         importance: jax.Array,
-        num_steps: int,
-        second_order: bool,
+        aug=None,
+        num_steps: int = 1,
+        second_order: bool = False,
         pred_step: int | None = None,
         final_only: bool = False,
         outer_grad: bool = True,
@@ -500,8 +516,17 @@ class MAMLFewShotLearner(CheckpointableLearner):
         mask = backbone.inner_loop_mask(theta)
         adapt0, frozen = partition(theta, mask)
         compute_dtype = self.cfg.dtype
-        x_support = decode_images(x_support, self.cfg.wire_codec, compute_dtype)
-        x_target = decode_images(x_target, self.cfg.wire_codec, compute_dtype)
+        # Wire decode + optional on-device train augmentation (``aug`` is
+        # the per-task operand of cfg.device_augment; eval batches never
+        # carry one, so those programs reduce to the plain decode).
+        x_support = decode_augment_images(
+            x_support, self.cfg.wire_codec, compute_dtype,
+            self.cfg.device_augment, aug, stream=0,
+        )
+        x_target = decode_augment_images(
+            x_target, self.cfg.wire_codec, compute_dtype,
+            self.cfg.device_augment, aug, stream=1,
+        )
         if final_only:
             assert pred_step is None or pred_step == num_steps - 1
         # Per-consumer fused-norm gating (BackboneConfig docstring). The
@@ -597,7 +622,10 @@ class MAMLFewShotLearner(CheckpointableLearner):
         final_only: bool = False,
         outer_grad: bool = True,
     ):
-        xs, xt, ys, yt = batch  # (B, N*K, C, H, W), ..., (B, N*K), (B, N*T)
+        # (B, N*K, C, H, W), ..., (B, N*K), (B, N*T); train batches of a
+        # device_augment config carry a trailing per-task aug operand.
+        xs, xt, ys, yt, *aug = batch
+        aug = aug[0] if aug else None
         per_task = functools.partial(
             self._task_adapt_and_losses,
             num_steps=num_steps,
@@ -606,9 +634,12 @@ class MAMLFewShotLearner(CheckpointableLearner):
             final_only=final_only,
             outer_grad=outer_grad,
         )
+        aug_axis = 0 if aug is not None else None
         weighted, aux = jax.vmap(
-            per_task, in_axes=(None, None, None, 0, 0, 0, 0, None)
-        )(outer["theta"], outer["lslr"], bn_state, xs, ys, xt, yt, importance)
+            per_task,
+            in_axes=(None, None, None, 0, 0, 0, 0, None, aug_axis),
+        )(outer["theta"], outer["lslr"], bn_state, xs, ys, xt, yt, importance,
+          aug)
         # Mean over tasks (few_shot_learning_system.py:164)
         return jnp.mean(weighted), aux
 
@@ -707,7 +738,11 @@ class MAMLFewShotLearner(CheckpointableLearner):
         reference's metric keys (``few_shot_learning_system.py:338-369``)."""
         epoch = int(epoch)
         self.current_epoch = epoch
-        batch = self._prepare_batch(data_batch)
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else self._prepare_batch(data_batch)
+        )
         importance = self._train_importance(epoch)
         lr = self._epoch_lr(epoch)
         state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))

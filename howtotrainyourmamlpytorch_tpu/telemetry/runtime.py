@@ -78,6 +78,7 @@ class TrainTelemetry:
         self._last_dispatch_t: float | None = None
         self._step_times: list[float] = []
         self._data_waits: list[float] = []
+        self._stage_waits: list[float] = []
         self._ended = False
 
     # ------------------------------------------------------------------
@@ -141,23 +142,42 @@ class TrainTelemetry:
             self.events.emit(event_type, **fields)
 
     def record_dispatch(
-        self, upto_iter: int, n_iters: int = 1, data_wait_s: float = 0.0
+        self,
+        upto_iter: int,
+        n_iters: int = 1,
+        data_wait_s: float = 0.0,
+        stage_wait_s: float = 0.0,
+        staged: bool = False,
     ) -> None:
         """One completed device dispatch ending at iteration ``upto_iter``
-        (``n_iters`` meta-updates; ``data_wait_s`` host time blocked on the
-        loader for its batches). The first dispatch after an epoch boundary
-        only drops the anchor — the val-epoch/checkpoint gap must not be
-        measured as a step."""
+        (``n_iters`` meta-updates). The first dispatch after an epoch
+        boundary only drops the anchor — the val-epoch/checkpoint gap must
+        not be measured as a step.
+
+        Wait split (the stage_wait extension of the PR 5 breakdown):
+        ``data_wait_s`` is host time blocked on EPISODE SYNTHESIS (the
+        loader queue — measured in the consumer without a stager, in the
+        stager thread with one), ``stage_wait_s`` is consumer time blocked
+        waiting for a STAGED device-resident group (encode + transfer not
+        keeping up). With ``staged`` the synthesis wait overlaps device
+        compute and is off the critical path, so only the stage wait is
+        subtracted from the step time to get the device share; unstaged,
+        the data wait is the consumer-blocking share exactly as before."""
         now = time.perf_counter()
         self.registry.gauge("current_iter").set(upto_iter)
         if self._last_dispatch_t is not None:
             total_s = now - self._last_dispatch_t
-            device_s = max(total_s - data_wait_s, 0.0)
+            blocking_s = stage_wait_s if staged else data_wait_s + stage_wait_s
+            device_s = max(total_s - blocking_s, 0.0)
             self._step_times.extend([total_s / n_iters] * n_iters)
             self._data_waits.extend([data_wait_s / n_iters] * n_iters)
+            self._stage_waits.extend([stage_wait_s / n_iters] * n_iters)
             self.registry.window("step_time_ms").observe(1e3 * total_s / n_iters)
             self.registry.window("data_wait_ms").observe(
                 1e3 * data_wait_s / n_iters
+            )
+            self.registry.window("stage_wait_ms").observe(
+                1e3 * stage_wait_s / n_iters
             )
             self.registry.counter("train_dispatches").inc()
             if self.events is not None:
@@ -167,6 +187,8 @@ class TrainTelemetry:
                     k=int(n_iters),
                     step_s=total_s,
                     data_wait_s=data_wait_s,
+                    stage_wait_s=stage_wait_s,
+                    staged=bool(staged),
                     device_s=device_s,
                 )
         self._last_dispatch_t = now
@@ -200,14 +222,18 @@ class TrainTelemetry:
         self._last_dispatch_t = None
         steps, self._step_times = self._step_times, []
         waits, self._data_waits = self._data_waits, []
+        stage_waits, self._stage_waits = self._stage_waits, []
         if steps:
             step_arr = np.asarray(steps)
             wait_arr = np.asarray(waits)
+            stage_arr = np.asarray(stage_waits)
             stats = {
                 f"{phase}_step_time_p50": float(np.percentile(step_arr, 50)),
                 f"{phase}_step_time_p95": float(np.percentile(step_arr, 95)),
                 f"{phase}_data_wait_p50": float(np.percentile(wait_arr, 50)),
                 f"{phase}_data_wait_p95": float(np.percentile(wait_arr, 95)),
+                f"{phase}_stage_wait_p50": float(np.percentile(stage_arr, 50)),
+                f"{phase}_stage_wait_p95": float(np.percentile(stage_arr, 95)),
             }
         else:
             stats = {
@@ -215,6 +241,8 @@ class TrainTelemetry:
                 f"{phase}_step_time_p95": float("nan"),
                 f"{phase}_data_wait_p50": float("nan"),
                 f"{phase}_data_wait_p95": float("nan"),
+                f"{phase}_stage_wait_p50": float("nan"),
+                f"{phase}_stage_wait_p95": float("nan"),
             }
         if self.events is not None:
             self.events.emit(
@@ -232,6 +260,7 @@ class TrainTelemetry:
         self._last_dispatch_t = None
         self._step_times = []
         self._data_waits = []
+        self._stage_waits = []
 
     def flush(self) -> None:
         if self.events is not None:

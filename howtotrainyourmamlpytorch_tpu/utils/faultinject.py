@@ -181,9 +181,11 @@ def poison_batch(sample, current_iter: int):
         return sample
     plan.nan_at_iter = None
     events.append(f"nan:{current_iter}")
-    xs, xt, ys, yt, seed = sample
+    # Samples are (xs, xt, ys, yt, seed) — plus a trailing on-device
+    # augmentation payload when the defer-augment loader is active.
+    xs, xt, *rest = sample
     xt = np.full_like(np.asarray(xt, dtype=np.float32), np.nan)
-    return (xs, xt, ys, yt, seed)
+    return (xs, xt, *rest)
 
 
 def poison_batches(samples, first_iter: int):

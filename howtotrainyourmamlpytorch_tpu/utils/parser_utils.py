@@ -142,6 +142,21 @@ def get_parser() -> argparse.ArgumentParser:
              "tunnel client's per-transfer host-memory leak (PERF_NOTES.md)")
     add("--iters_per_dispatch", type=int, default=1,
         help="K meta-updates per device dispatch (lax.scan iteration batching)")
+    add("--device_prefetch", type=int, default=-1,
+        help="device-side async prefetch depth (data/device_prefetch.py): "
+             "stage prepare_batch + device_put of the next N dispatch "
+             "groups on a background thread so the chip never waits on "
+             "host data work. -1 (default) auto-sizes from the measured "
+             "stage-wait distribution (double-buffered, deepening to 4); "
+             "0 disables (host batches prepared inline, the pre-PR path); "
+             "N pins the depth")
+    add("--device_augment", type=str, default="False",
+        help="move the stochastic train augmentation into the jitted step "
+             "(models/common.DeviceAugment): omniglot's class-level k*90 "
+             "rotation as an in-step rot90-by-gather (bit-exact vs the "
+             "host transform), cifar's crop+flip as a per-episode-keyed "
+             "in-step transform (requires --transfer_dtype uint8). The "
+             "host then ships raw uint8 pixels only")
     add("--data_parallel_devices", type=int, default=0,
         help="0 = all local devices; shards the task axis over the mesh")
     add("--profile_trace_path", type=str, default="",
@@ -261,6 +276,33 @@ def get_args(argv=None):
     return args, device
 
 
+def device_augment_for(args):
+    """The on-device augmentation spec for ``args`` (``--device_augment``),
+    or None. Omniglot's class-level rotation becomes the in-step
+    rot90-by-gather (bit-exact); cifar's crop+flip becomes the
+    per-episode-keyed in-step transform, which REQUIRES the deferred-
+    normalization uint8 wire (--transfer_dtype uint8) so the crop pads raw
+    pixels like the host does. ImageNet has no stochastic train transform,
+    so the flag is a no-op there."""
+    from ..models.common import DeviceAugment, wire_codec_for
+
+    if not bool(getattr(args, "device_augment", False)):
+        return None
+    name = args.dataset_name.lower()
+    if "omniglot" in name:
+        return DeviceAugment("rot90")
+    if "cifar10" in name or "cifar100" in name:
+        codec = wire_codec_for(args)
+        if codec is None or codec.mean is None:
+            raise ValueError(
+                "--device_augment on cifar requires --transfer_dtype uint8 "
+                "(the on-device crop must pad raw pixels before the "
+                "deferred normalization, matching the host transform order)"
+            )
+        return DeviceAugment("crop_flip", pad=4)
+    return None
+
+
 def args_to_maml_config(args):
     """Maps a parsed ``Bunch`` onto the static ``MAMLConfig``/``BackboneConfig``
     pair consumed by the learners (flag semantics per SURVEY §5 C19)."""
@@ -323,6 +365,7 @@ def args_to_maml_config(args):
     else:
         task_lr = float(getattr(args, "init_inner_loop_learning_rate", 0.1))
     return MAMLConfig(
+        device_augment=device_augment_for(args),
         backbone=backbone,
         number_of_training_steps_per_iter=int(args.number_of_training_steps_per_iter),
         number_of_evaluation_steps_per_iter=int(

@@ -2,6 +2,7 @@
 augmentation, loader batching and resume seed fast-forward (SURVEY §4 test
 strategy — fixed-seed episode-sampler golden behavior)."""
 
+import threading
 import time
 import json
 import os
@@ -230,6 +231,111 @@ def test_loader_propagates_synthesis_errors(dataset_env):
     loader.dataset.get_set = boom
     with pytest.raises(ValueError, match="corrupt image"):
         list(loader.get_train_batches(total_batches=2, augment_images=False))
+
+
+def test_defer_augment_ships_raw_pixels_plus_rotation_payload(dataset_env):
+    """--device_augment episodes: raw (unrotated) pixels + the per-class
+    quarter-turn payload, with the episode RNG stream (class/sample/k
+    selection) bit-identical to the host-augmented mode. Applying the host
+    rotation to the raw pixels with the shipped ks reproduces the
+    host-augmented episode exactly — the transform moved, nothing else."""
+    args = make_args(dataset_env)
+    args_dev = make_args(dataset_env, device_augment=True)
+    ds_host = FewShotLearningDataset(args)
+    ds_dev = FewShotLearningDataset(args_dev)
+    assert ds_dev.defer_augment and not ds_host.defer_augment
+
+    for seed in (123, 321):
+        host = ds_host.get_set("train", seed=seed, augment_images=True)
+        raw = ds_dev.get_set("train", seed=seed, augment_images=True)
+        assert len(host) == 5 and len(raw) == 6
+        xs_raw, xt_raw, ys, yt, _seed, ks = raw
+        assert ks.shape == (args.num_classes_per_set,)
+        np.testing.assert_array_equal(ys, host[2])
+        # Raw pixels == the unaugmented episode (same selection stream).
+        plain = ds_host.get_set("train", seed=seed, augment_images=False)
+        np.testing.assert_array_equal(xs_raw, plain[0])
+        # Host-rotating the raw pixels with the shipped ks == host episode.
+        for raw_x, host_x in ((xs_raw, host[0]), (xt_raw, host[1])):
+            rotated = np.stack([
+                np.stack([
+                    np.transpose(
+                        rotate_image(np.transpose(im, (1, 2, 0)), int(k)),
+                        (2, 0, 1),
+                    )
+                    for im in cls
+                ])
+                for cls, k in zip(raw_x, ks)
+            ])
+            np.testing.assert_array_equal(rotated, host_x)
+    # Eval episodes apply no augmentation -> plain 5-tuple, no payload.
+    assert len(ds_dev.get_set("val", seed=7, augment_images=False)) == 5
+
+
+def test_loader_collates_defer_augment_payload(dataset_env):
+    args = make_args(dataset_env, device_augment=True)
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+    batches = list(loader.get_train_batches(total_batches=2,
+                                            augment_images=True))
+    for batch in batches:
+        assert len(batch) == 6
+        assert batch[5].shape == (args.batch_size, args.num_classes_per_set)
+        assert batch[5].dtype == np.int32
+    # Val batches stay 5-element (no augmentation, no payload).
+    val = list(loader.get_val_batches(total_batches=1, augment_images=False))
+    assert len(val[0]) == 5
+
+
+def test_builder_rollback_shuts_down_stager_and_releases_buffers(dataset_env):
+    """Satellite (ISSUE 7): abandoning a mid-epoch iteration through the
+    builder's ROLLBACK path must close the device-prefetch stager — thread
+    stopped, staged device buffers deleted — before the replay builds its
+    replacement. An abandoned stager would otherwise pin up to ``depth``
+    dispatch groups of device memory for the rest of the run."""
+    import pytest
+
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        ExperimentBuilder,
+    )
+    from howtotrainyourmamlpytorch_tpu.utils import faultinject
+    from test_faultinject import _builder, _exp_args
+
+    tmp = dataset_env
+    stagers = []
+    original = ExperimentBuilder._make_stager
+
+    def spying(self, batches):
+        stager = original(self, batches)
+        stagers.append(stager)
+        return stager
+
+    ExperimentBuilder._make_stager = spying
+    # Float wire: NaN poisoning rides the real data path (uint8 clips it).
+    faultinject.activate(faultinject.FaultPlan(nan_at_iter=1))
+    try:
+        builder = _builder(
+            _exp_args(tmp, "exp_stager_rollback", on_nonfinite="rollback",
+                      total_epochs_before_pause=1)
+        )
+        with pytest.raises(SystemExit):
+            builder.run_experiment()
+    finally:
+        ExperimentBuilder._make_stager = original
+        fault_events = list(faultinject.events)
+        faultinject.reset()
+
+    # The poisoned first pass was abandoned by the rollback; its stager
+    # (and the replay's, finished normally) must both be fully closed.
+    assert len(stagers) >= 2, "rollback did not re-enter the train loop"
+    assert fault_events and fault_events[0] == "nan:1"
+    for stager in stagers:
+        assert stager.closed
+        assert not stager._thread.is_alive()
+        assert stager._buffer == []
+    assert not any(
+        t.name == "device-prefetch-stager" and t.is_alive()
+        for t in threading.enumerate()
+    )
 
 
 def test_process_backend_matches_thread_backend(dataset_env):

@@ -1,0 +1,374 @@
+"""Device-side async prefetch stager tests (data/device_prefetch.py).
+
+The contracts that make the stager safe to run by default:
+
+* staged training is BIT-IDENTICAL to the host path on the K=1 and K-scan
+  dispatch paths (the stager only moves prepare/transfer off the critical
+  path — it must not change a single bit of any update);
+* zero new compile signatures and zero host syncs with the stager active
+  (compile_guard + a ``jax.device_get`` count over the staged loop);
+* dispatch groups match the builder's chunking and never straddle an epoch
+  boundary;
+* lifecycle: producer errors propagate, ``close()`` stops the thread and
+  releases every unconsumed staged device buffer, auto depth grows only
+  under measured consumer starvation.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.data.device_prefetch import (
+    AUTO_DEPTH,
+    DEFAULT_DEPTH,
+    MAX_AUTO_DEPTH,
+    DevicePrefetcher,
+)
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.models.common import (
+    StagedBatch,
+    WireCodec,
+    prepare_batch,
+)
+
+
+def tiny_cfg(**kw):
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        second_order=False,
+        wire_codec=WireCodec(1.0, None, None),
+        **kw,
+    )
+
+
+def make_samples(rng, n, tasks=2):
+    """n loader-layout samples (xs, xt, ys, yt, seed), each distinct."""
+    samples = []
+    for i in range(n):
+        xs = rng.randint(0, 2, (tasks, 5, 1, 1, 8, 8)).astype(np.float32)
+        xt = rng.randint(0, 2, (tasks, 5, 1, 1, 8, 8)).astype(np.float32)
+        ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(
+            np.int32
+        )
+        samples.append((xs, xt, ys, ys.copy(), np.full(tasks, 100 + i)))
+    return samples
+
+
+def stage_all(samples, codec, **kwargs):
+    stager = DevicePrefetcher(
+        iter(samples), lambda b: prepare_batch(b, codec=codec), **kwargs
+    )
+    try:
+        return list(stager), stager
+    finally:
+        stager.close()
+
+
+# ---------------------------------------------------------------------------
+# Bit-exactness: staged == host path
+# ---------------------------------------------------------------------------
+
+
+def test_staged_k1_training_bitwise_identical():
+    rng = np.random.RandomState(0)
+    samples = make_samples(rng, 5)
+    learner = MAMLFewShotLearner(tiny_cfg())
+    s_host = learner.init_state(jax.random.PRNGKey(7))
+    s_staged = learner.init_state(jax.random.PRNGKey(7))
+
+    for sample in samples:
+        s_host, _ = learner.run_train_iter(s_host, sample[:4], epoch=0)
+
+    staged, stager = stage_all(
+        samples, learner.cfg.wire_codec, depth=2, group=1
+    )
+    assert [b.n_iters for b in staged] == [1] * 5
+    assert [b.first_iter for b in staged] == list(range(5))
+    for batch in staged:
+        assert isinstance(batch, StagedBatch)
+        s_staged, _ = learner.run_train_iter(s_staged, batch, epoch=0)
+
+    for a, b in zip(jax.tree.leaves(s_host), jax.tree.leaves(s_staged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_staged_group_dispatch_bitwise_identical():
+    """group=K stages whole scan dispatches (pre-stacked form); the final
+    partial group matches the builder's epoch-tail flush."""
+    rng = np.random.RandomState(1)
+    samples = make_samples(rng, 7)
+    learner = MAMLFewShotLearner(tiny_cfg())
+    s_host = learner.init_state(jax.random.PRNGKey(9))
+    s_staged = learner.init_state(jax.random.PRNGKey(9))
+
+    for chunk in (samples[:3], samples[3:6], samples[6:]):
+        s_host, _ = learner.run_train_iters(
+            s_host, [c[:4] for c in chunk], epoch=0
+        )
+
+    staged, _ = stage_all(samples, learner.cfg.wire_codec, depth=2, group=3)
+    assert [b.n_iters for b in staged] == [3, 3, 1]
+    assert [b.first_iter for b in staged] == [0, 3, 6]
+    for batch in staged:
+        s_staged, _ = learner.run_train_iters(s_staged, batch, epoch=0)
+
+    for a, b in zip(jax.tree.leaves(s_host), jax.tree.leaves(s_staged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_groups_never_straddle_epoch_boundary():
+    rng = np.random.RandomState(2)
+    samples = make_samples(rng, 8)
+    staged, _ = stage_all(
+        samples, None, depth=2, group=3, start_iter=0, epoch_len=4
+    )
+    assert [b.n_iters for b in staged] == [3, 1, 3, 1]
+    assert [b.first_iter for b in staged] == [0, 3, 4, 7]
+    # A mid-epoch resume point (start_iter=3, boundaries at 4 and 8):
+    # iters 3 | 4,5,6 | 7 | 8,9,10.
+    staged, _ = stage_all(
+        samples, None, depth=2, group=3, start_iter=3, epoch_len=4
+    )
+    assert [b.n_iters for b in staged] == [1, 3, 1, 3]
+    assert [b.first_iter for b in staged] == [3, 4, 7, 8]
+
+
+# ---------------------------------------------------------------------------
+# Zero new compile signatures, zero host syncs
+# ---------------------------------------------------------------------------
+
+
+def test_staged_k1_mints_no_new_signatures_and_no_syncs(compile_guard):
+    """One warm host-path dispatch, then a staged loop: the step program
+    must compile exactly once TOTAL (staged arrays present the identical
+    signature) and the staged loop must trigger zero jax.device_get."""
+    rng = np.random.RandomState(3)
+    samples = make_samples(rng, 6)
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.PRNGKey(11))
+
+    with compile_guard() as guard:
+        state, _ = learner.run_train_iter(state, samples[0][:4], epoch=0)
+        jax.block_until_ready(state.theta)
+
+        device_gets = {"n": 0}
+        real_device_get = jax.device_get
+
+        def counting_device_get(x):
+            device_gets["n"] += 1
+            return real_device_get(x)
+
+        jax.device_get = counting_device_get
+        try:
+            staged, _ = stage_all(
+                samples[1:], learner.cfg.wire_codec, depth=2, group=1
+            )
+            for batch in staged:
+                state, _ = learner.run_train_iter(state, batch, epoch=0)
+            jax.block_until_ready(state.theta)
+        finally:
+            jax.device_get = real_device_get
+    guard.assert_compiles("_train_step", exactly=1)
+    guard.assert_unique_signatures("_train_step")
+    assert device_gets["n"] == 0
+
+
+def test_staged_k_scan_mints_no_new_signatures(compile_guard):
+    rng = np.random.RandomState(4)
+    samples = make_samples(rng, 9)
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.PRNGKey(13))
+    with compile_guard() as guard:
+        state, _ = learner.run_train_iters(
+            state, [s[:4] for s in samples[:3]], epoch=0
+        )
+        staged, _ = stage_all(
+            samples[3:], learner.cfg.wire_codec, depth=2, group=3
+        )
+        for batch in staged:
+            state, _ = learner.run_train_iters(state, batch, epoch=0)
+        jax.block_until_ready(state.theta)
+    guard.assert_compiles("multi", exactly=1)
+    guard.assert_unique_signatures("multi")
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_producer_error_propagates_to_consumer():
+    def exploding():
+        yield from make_samples(np.random.RandomState(5), 1)
+        raise ValueError("corrupt image mid-epoch")
+
+    stager = DevicePrefetcher(
+        exploding(), lambda b: prepare_batch(b), depth=2, group=1
+    )
+    try:
+        next(stager)
+        with pytest.raises(ValueError, match="corrupt image"):
+            for _ in stager:
+                pass
+    finally:
+        stager.close()
+
+
+def test_close_stops_thread_and_releases_device_buffers():
+    rng = np.random.RandomState(6)
+    stager = DevicePrefetcher(
+        iter(make_samples(rng, 6)),
+        lambda b: prepare_batch(b),
+        depth=3,
+        group=1,
+    )
+    first = next(stager)
+    # Let the stager fill its buffer, then abandon it mid-stream.
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with stager._lock:
+            if len(stager._buffer) >= 3:
+                break
+        time.sleep(0.01)
+    with stager._lock:
+        buffered = list(stager._buffer)
+    assert buffered, "stager never filled its buffer"
+    stager.close()
+    assert stager.closed
+    assert not stager._thread.is_alive()
+    assert stager.released_buffers >= len(buffered)
+    # The unconsumed staged device buffers were DELETED, not just dropped.
+    for batch in buffered:
+        for leaf in batch.arrays:
+            assert leaf.is_deleted()
+    # The consumed batch stays usable — close only releases unconsumed ones.
+    assert not first.arrays[0].is_deleted()
+    stager.close()  # idempotent
+
+
+def test_close_is_safe_while_producer_blocked_on_full_buffer():
+    rng = np.random.RandomState(7)
+    stager = DevicePrefetcher(
+        iter(make_samples(rng, 50)),
+        lambda b: prepare_batch(b),
+        depth=1,
+        group=1,
+    )
+    next(stager)
+    time.sleep(0.05)  # producer parks on the full buffer
+    stager.close()
+    assert not stager._thread.is_alive()
+    assert not any(
+        t.name == "device-prefetch-stager" and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+def test_close_returns_promptly_when_producer_blocked_upstream():
+    """A producer parked inside ``next(source)`` (empty loader queue)
+    cannot be interrupted; close() must not stall the preemption/rollback
+    shutdown paths behind a long join waiting for it."""
+    release = threading.Event()
+
+    def stuck_source():
+        release.wait(30)
+        yield None
+
+    stager = DevicePrefetcher(
+        stuck_source(), lambda b: b, depth=2, group=1
+    )
+    try:
+        time.sleep(0.05)  # let the producer park in next(source)
+        t0 = time.monotonic()
+        stager.close()
+        assert time.monotonic() - t0 < 10.0
+        assert stager.closed
+    finally:
+        release.set()
+
+
+def test_builder_disables_stager_on_mesh_runs():
+    """Sharded runs pin in_shardings on the step programs; the stager's
+    bare single-device device_put would conflict. The builder must fall
+    back to the inline host loop whenever the learner carries a mesh."""
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        ExperimentBuilder,
+    )
+
+    class Stub:
+        pass
+
+    builder = Stub()
+    builder.device_prefetch = -1
+    builder.model = Stub()
+    builder.model.mesh = object()  # any active mesh
+    assert ExperimentBuilder._make_stager(builder, iter(())) is None
+
+    builder.device_prefetch = 0
+    builder.model.mesh = None
+    assert ExperimentBuilder._make_stager(builder, iter(())) is None
+
+
+def test_pop_waits_split_and_auto_depth_growth():
+    """A slow upstream source accrues data_wait in the stager and
+    stage_wait in the consumer; repeated starvation deepens auto mode."""
+    rng = np.random.RandomState(8)
+    samples = make_samples(rng, 30)
+
+    def slow_source():
+        for s in samples:
+            time.sleep(0.002)
+            yield s
+
+    stager = DevicePrefetcher(
+        slow_source(), lambda b: prepare_batch(b), depth=AUTO_DEPTH, group=1
+    )
+    try:
+        assert stager.depth == DEFAULT_DEPTH
+        for _ in stager:
+            pass
+        data_wait_s, stage_wait_s = stager.pop_waits()
+        assert data_wait_s > 0.0
+        assert stage_wait_s > 0.0
+        assert DEFAULT_DEPTH < stager.depth <= MAX_AUTO_DEPTH
+        # pop_waits resets the accumulators.
+        assert stager.pop_waits() == (0.0, 0.0)
+    finally:
+        stager.close()
+
+
+def test_pinned_depth_never_grows():
+    rng = np.random.RandomState(9)
+    samples = make_samples(rng, 20)
+
+    def slow_source():
+        for s in samples:
+            time.sleep(0.002)
+            yield s
+
+    stager = DevicePrefetcher(
+        slow_source(), lambda b: prepare_batch(b), depth=2, group=1
+    )
+    try:
+        for _ in stager:
+            pass
+        assert stager.depth == 2
+    finally:
+        stager.close()

@@ -341,6 +341,37 @@ def test_device_op_negative_numpy_loader_and_non_data_module():
     )
 
 
+def test_device_op_allowlists_the_prefetch_stager_only():
+    """The device-prefetch stager is the ONE sanctioned jax import in the
+    data path (its job is the async device_put) — allowlisted in the rule
+    itself, not via an inline suppression. Any other data/ module using
+    jax still flags."""
+    stager_src = """
+    import jax
+
+    def stage(batch):
+        return jax.device_put(batch)
+    """
+    assert "device-op-in-data-path" not in rules_of(
+        stager_src, path="pkg/data/device_prefetch.py"
+    )
+    # The same source anywhere else in data/ is still a violation —
+    # including a BRAND-NEW data/ module (directory-scoped, not a file
+    # list: coverage does not wait for someone to extend an enum).
+    assert "device-op-in-data-path" in rules_of(
+        stager_src, path="pkg/data/dataset.py"
+    )
+    assert "device-op-in-data-path" in rules_of(
+        stager_src, path="pkg/data/fast_synth.py"
+    )
+    assert "device-op-in-data-path" in rules_of(
+        stager_src, path="pkg/data/brand_new_module.py"
+    )
+    assert "device-op-in-data-path" in rules_of(
+        stager_src, path="data/loader.py"  # bare relative path
+    )
+
+
 # ---------------------------------------------------------------------------
 # traced-mutation
 # ---------------------------------------------------------------------------

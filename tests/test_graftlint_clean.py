@@ -146,6 +146,30 @@ def test_resilience_layer_lints_clean_standalone():
             assert "graftlint: disable" not in f.read(), path
 
 
+def test_device_prefetch_lints_clean_standalone():
+    """The device-prefetch stager (ISSUE 7) stays lint-clean as its own
+    target with ZERO suppressions. Its ``jax.device_put`` is the one
+    sanctioned exception to ``device-op-in-data-path``, granted via the
+    rule's own allowlist — an inline suppression would weaken the
+    data-path ban for every future edit of the file."""
+    stager_py = os.path.join(
+        REPO, "howtotrainyourmamlpytorch_tpu", "data", "device_prefetch.py"
+    )
+    assert os.path.isfile(stager_py)
+    proc = run_cli(stager_py)
+    assert proc.returncode == 0, (
+        "graftlint found violations in the device-prefetch stager:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "graftlint: clean" in proc.stderr
+
+    from tools.graftlint import lint_paths
+
+    assert lint_paths([stager_py]) == []
+    with open(stager_py) as f:
+        assert "graftlint: disable" not in f.read()
+
+
 def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(

@@ -219,6 +219,130 @@ def test_uint8_wire_training_bitwise_identical(omniglot_env):
     np.testing.assert_array_equal(np.asarray(pf), np.asarray(pu))
 
 
+def test_on_device_rotation_training_bitwise_identical(omniglot_env):
+    """--device_augment omniglot: training on raw-pixel episodes with the
+    in-step rot90-by-gather is BIT-EXACT vs training on host-rotated
+    episodes, over multiple iterations AND through the eval path — the
+    on-device extension of the uint8-wire bit-exactness contract (a
+    rotation is pure data movement; rotating 0/1 pixels is exact in any
+    dtype)."""
+    from howtotrainyourmamlpytorch_tpu.data import FewShotLearningDataset
+
+    args_host = _learner_args(omniglot_env, transfer_dtype="uint8")
+    args_dev = _learner_args(omniglot_env, transfer_dtype="uint8",
+                             device_augment=True)
+    ds_host = FewShotLearningDataset(args_host)
+    ds_dev = FewShotLearningDataset(args_dev)
+    lh = MAMLFewShotLearner(args_to_maml_config(args_host))
+    ld = MAMLFewShotLearner(args_to_maml_config(args_dev))
+    assert ld.cfg.device_augment is not None
+    assert lh.cfg.device_augment is None
+
+    def batch_from(ds, seeds):
+        episodes = [ds.get_set("train", seed=s, augment_images=True)
+                    for s in seeds]
+        cols = list(zip(*episodes))
+        return tuple(np.stack(c) for c in cols[:4]) + tuple(
+            np.asarray(c) for c in cols[5:]
+        )
+
+    sh = lh.init_state(jax.random.PRNGKey(21))
+    sd = ld.init_state(jax.random.PRNGKey(21))
+    for it in range(3):
+        seeds = [1000 + 10 * it, 2000 + 10 * it]
+        bh, bd = batch_from(ds_host, seeds), batch_from(ds_dev, seeds)
+        assert len(bh) == 4 and len(bd) == 5  # raw pixels + ks payload
+        sh, mh = lh.run_train_iter(sh, bh, epoch=0)
+        sd, md = ld.run_train_iter(sd, bd, epoch=0)
+        assert float(mh["loss"]) == float(md["loss"]), f"iter {it}"
+    for a, b in zip(jax.tree.leaves(sh), jax.tree.leaves(sd)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Eval applies no augmentation on either side: identical programs.
+    eval_batch = batch_from(ds_host, [31, 32])
+    _, eh, ph = lh.run_validation_iter(sh, eval_batch)
+    _, ed, pd = ld.run_validation_iter(sd, eval_batch)
+    assert float(eh["loss"]) == float(ed["loss"])
+    np.testing.assert_array_equal(np.asarray(ph), np.asarray(pd))
+
+    # The baselines share the decode+augment path (models/common.
+    # decode_train_batch): same bit-exactness contract for both.
+    from howtotrainyourmamlpytorch_tpu.models import (
+        GradientDescentLearner,
+        MatchingNetsLearner,
+    )
+
+    for cls in (GradientDescentLearner, MatchingNetsLearner):
+        bh, bd = batch_from(ds_host, [51, 52]), batch_from(ds_dev, [51, 52])
+        blh = cls(args_to_maml_config(args_host))
+        bld = cls(args_to_maml_config(args_dev))
+        sbh = blh.init_state(jax.random.PRNGKey(23))
+        sbd = bld.init_state(jax.random.PRNGKey(23))
+        sbh, mbh = blh.run_train_iter(sbh, bh, epoch=0)
+        sbd, mbd = bld.run_train_iter(sbd, bd, epoch=0)
+        assert float(mbh["loss"]) == float(mbd["loss"]), cls.__name__
+        for a, b in zip(jax.tree.leaves(sbh), jax.tree.leaves(sbd)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cifar_crop_flip_fixed_key_parity():
+    """The on-device cifar crop/flip is pinned by fixed-key parity: for a
+    given episode key the device transform's draws, reproduced on the
+    host and applied with the HOST pipeline's own crop/flip (pad-4 +
+    slice + mirror, data/augment._random_crop semantics), give identical
+    pixels. Draw laws match torchvision RandomCrop(32, 4) +
+    RandomHorizontalFlip: offsets uniform over [0, 2*pad], flips p=0.5."""
+    from howtotrainyourmamlpytorch_tpu.models.common import crop_flip_by_key
+
+    rng = np.random.RandomState(11)
+    pad, h, w = 4, 32, 32
+    x = rng.randint(0, 256, (6, 3, h, w)).astype(np.float32) / 255.0
+    for seed, stream in ((77, 0), (77, 1), (1234, 0)):
+        device = np.asarray(
+            crop_flip_by_key(jnp.asarray(x), jnp.uint32(seed), pad, stream)
+        )
+        # Reproduce the draws exactly as the device transform makes them.
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), stream)
+        k_off, k_flip = jax.random.split(key)
+        offs = np.asarray(
+            jax.random.randint(k_off, (x.shape[0], 2), 0, 2 * pad + 1)
+        )
+        flips = np.asarray(
+            jax.random.bernoulli(k_flip, 0.5, (x.shape[0],))
+        )
+        assert offs.min() >= 0 and offs.max() <= 2 * pad
+        # Apply them with the host pipeline's own padded-crop + mirror.
+        host = []
+        for img, (top, left), flip in zip(x, offs, flips):
+            padded = np.pad(img, ((0, 0), (pad, pad), (pad, pad)))
+            crop = padded[:, top:top + h, left:left + w]
+            host.append(crop[..., ::-1] if flip else crop)
+        np.testing.assert_array_equal(device, np.stack(host))
+    # Different streams (support vs target) draw independently.
+    a = np.asarray(crop_flip_by_key(jnp.asarray(x), jnp.uint32(5), pad, 0))
+    b = np.asarray(crop_flip_by_key(jnp.asarray(x), jnp.uint32(5), pad, 1))
+    assert not np.array_equal(a, b)
+
+
+def test_cifar_device_augment_requires_uint8_wire(tmp_path):
+    """crop_flip without the deferred-normalization codec would pad
+    NORMALIZED pixels with zeros (diverging from the reference's
+    pad-before-normalize order) — refused at config build."""
+    from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+        device_augment_for,
+    )
+
+    good = _args(tmp_path, dataset_name="cifar100", transfer_dtype="uint8",
+                 device_augment=True,
+                 classification_mean=[0.5, 0.5, 0.5],
+                 classification_std=[0.25, 0.25, 0.25])
+    assert device_augment_for(good).kind == "crop_flip"
+    bad = _args(tmp_path, dataset_name="cifar100", device_augment=True,
+                classification_mean=[0.5, 0.5, 0.5],
+                classification_std=[0.25, 0.25, 0.25])
+    with pytest.raises(ValueError, match="transfer_dtype uint8"):
+        device_augment_for(bad)
+
+
 def test_uint8_wire_gd_and_matching_nets_bitwise_identical(omniglot_env):
     """The baselines decode the wire too (review finding: with a deferred-
     normalization codec their steps would otherwise train on raw pixels)."""

@@ -77,12 +77,17 @@ def summarize(events: list[dict]) -> dict:
     t0 = min((float(e["t"]) for e in events), default=0.0)
     steps = [e for e in events if e.get("type") == "step"]
     per_iter: dict[str, list[float]] = {
-        "step": [], "data_wait": [], "device": [],
+        "step": [], "data_wait": [], "stage_wait": [], "device": [],
     }
     for e in steps:
         k = max(int(e.get("k", 1)), 1)
         per_iter["step"].extend([float(e["step_s"]) / k] * k)
         per_iter["data_wait"].extend([float(e["data_wait_s"]) / k] * k)
+        # stage_wait: consumer blocked on a staged device buffer (absent
+        # from pre-stager event logs — the row simply drops out then).
+        per_iter["stage_wait"].extend(
+            [float(e.get("stage_wait_s", 0.0)) / k] * k
+        )
         per_iter["device"].extend([float(e["device_s"]) / k] * k)
     syncs = [
         float(e["sync_s"]) for e in events if e.get("type") == "host_sync"
@@ -139,7 +144,7 @@ def render_text(summary: dict) -> str:
     )
     lines.append(header)
     lines.append("  " + "-" * (len(header) - 2))
-    for name in ("step", "data_wait", "device", "host_sync"):
+    for name in ("step", "data_wait", "stage_wait", "device", "host_sync"):
         row = summary["breakdown"].get(name)
         if row is None:
             continue
