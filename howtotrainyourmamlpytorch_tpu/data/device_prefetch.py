@@ -38,6 +38,11 @@ Design contracts:
 * **Deterministic faults.** ``utils.faultinject.poison_batch`` runs on the
   host sample inside the stager (a None-check no-op when inactive), so
   ``nan_at_iter`` keeps poisoning the exact planned iteration.
+* **Mesh-aware.** With ``sharding`` set (the learner's declared batch
+  ``in_shardings`` — ``staged_batch_sharding``), the put is sharding-aware:
+  staged arrays land already laid out across the mesh, so dp-sharded
+  multi-chip runs keep the overlapped pipeline instead of falling back to
+  the inline host loop (PR 7's explicit gap).
 * **Lifecycle.** ``close()`` (idempotent; also invoked by abandoning the
   iterator via ``with``-less ``for`` + builder rollback/preemption paths)
   stops the thread and deletes every unconsumed staged device buffer, so
@@ -97,11 +102,21 @@ class DevicePrefetcher:
         group: int = 1,
         start_iter: int = 0,
         epoch_len: int | None = None,
+        sharding=None,
     ):
         if group < 1:
             raise ValueError(f"group must be >= 1, got {group}")
         self._source = source
         self._prepare = prepare
+        # Mesh-aware staging: a jax.sharding.Sharding applied to every
+        # staged array (the learner's declared batch in_shardings — task
+        # axis over 'dp'), so multi-chip runs keep the overlapped pipeline:
+        # the staged arrays arrive already laid out for the pinned step
+        # programs instead of committed to one device (which would either
+        # trip a committed-device mismatch or insert a reshard copy on the
+        # critical path — why PR 7 disabled staging on mesh runs). None =
+        # single-device put, the PR 7 behavior.
+        self._sharding = sharding
         self._auto = depth == AUTO_DEPTH
         self._capacity = DEFAULT_DEPTH if self._auto else int(depth)
         if self._capacity < 1:
@@ -167,8 +182,13 @@ class DevicePrefetcher:
                 np.stack([p[i] for p in prepared])
                 for i in range(len(prepared[0]))
             )
+        staged = (
+            jax.device_put(arrays)
+            if self._sharding is None
+            else jax.device_put(arrays, self._sharding)
+        )
         return StagedBatch(
-            arrays=jax.device_put(arrays),
+            arrays=staged,
             n_iters=len(samples),
             first_iter=first_iter,
         )

@@ -128,6 +128,12 @@ class ExperimentBuilder:
         self.create_summary_csv = False
 
         self.train_state = model.init_state(jax.random.PRNGKey(args.seed))
+        # Mesh runs: lay the fresh state out per the learner's declared
+        # sharding rules (parallel/sharding; no-op without a mesh). Resume
+        # paths re-shard inside load_model, so every entry to the train
+        # loop sees the same layout.
+        if hasattr(model, "shard_state"):
+            self.train_state = model.shard_state(self.train_state)
 
         if args.continue_from_epoch == "from_scratch":
             self.create_summary_csv = True
@@ -192,9 +198,24 @@ class ExperimentBuilder:
         # the summary CSV, and on-demand bounded jax.profiler captures
         # (file trigger / SIGUSR1, generalizing the first-N-iters-only
         # --profile_trace_path hook).
+        # Mesh attribution: every step event and the epoch CSV carry the
+        # device count + mesh shape, so a multichip regression is
+        # attributable in tools/telemetry_report.py without re-deriving the
+        # topology from logs.
+        mesh = getattr(model, "mesh", None)
+        if mesh is not None:
+            axes = dict(mesh.shape)
+            mesh_dp = int(axes.get("dp", 1))
+            mesh_mp = int(axes.get("mp", 1))
+            n_devices = int(np.prod(list(axes.values())))
+        else:
+            n_devices = mesh_dp = mesh_mp = 1
         self.telemetry = TrainTelemetry(
             self.logs_filepath,
             enabled=bool(getattr(args, "telemetry", True)),
+            n_devices=n_devices,
+            mesh_dp=mesh_dp,
+            mesh_mp=mesh_mp,
             profile_trace_path=str(
                 getattr(args, "profile_trace_path", "") or ""
             ),
@@ -545,6 +566,8 @@ class ExperimentBuilder:
             self.train_state = self.model.init_state(
                 jax.random.PRNGKey(self.args.seed)
             )
+            if hasattr(self.model, "shard_state"):
+                self.train_state = self.model.shard_state(self.train_state)
             self.state = {
                 "best_val_acc": 0.0,
                 "best_val_iter": 0,
@@ -849,17 +872,24 @@ class ExperimentBuilder:
         """Wraps a fresh train-batch generator in the device prefetcher
         (``--device_prefetch``; 0 disables). Dispatch groups match the
         builder's own chunking: ``iters_per_dispatch`` on the K-scan path,
-        single batches otherwise, never straddling an epoch boundary."""
+        single batches otherwise, never straddling an epoch boundary.
+
+        Mesh runs stage too (PR 7's explicit gap, closed): the learner's
+        ``staged_batch_sharding`` is the batch layout its pinned
+        ``in_shardings`` expect, and the stager's sharding-aware
+        ``device_put`` lands staged arrays directly in it. A learner that
+        declines (``None`` with a mesh — the arg-driven mp layout) keeps
+        the inline host loop: a committed staged layout there could force
+        a reshard copy onto the critical path."""
         if self.device_prefetch == 0:
             return None
+        group = self.iters_per_dispatch if self._use_multi else 1
+        sharding = None
         if getattr(self.model, "mesh", None) is not None:
-            # Sharded runs pin in_shardings on the step programs; the
-            # stager's bare device_put would commit staged arrays to one
-            # device and either trip a committed-device mismatch or insert
-            # a reshard copy on the critical path. Mesh-aware staging
-            # (device_put with the batch sharding) is follow-up work — the
-            # multichip path keeps the inline host loop for now.
-            return None
+            sharding_for = getattr(self.model, "staged_batch_sharding", None)
+            sharding = sharding_for(group) if sharding_for is not None else None
+            if sharding is None:
+                return None
         codec = getattr(self.model.cfg, "wire_codec", None)
 
         def prepare(host_batch):
@@ -872,9 +902,10 @@ class ExperimentBuilder:
                 self.device_prefetch if self.device_prefetch > 0
                 else AUTO_DEPTH
             ),
-            group=self.iters_per_dispatch if self._use_multi else 1,
+            group=group,
             start_iter=int(self.state["current_iter"]),
             epoch_len=int(self.args.total_iter_per_epoch),
+            sharding=sharding,
         )
 
     def _train_until_rollback(self, total_iters):

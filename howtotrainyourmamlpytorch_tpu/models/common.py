@@ -408,12 +408,69 @@ class CheckpointableLearner:
     """Reference trainer-contract checkpoint methods
     (``few_shot_learning_system.py:399-424``): ``save_model`` writes the full
     train-state pytree + experiment state to one file; ``load_model`` restores
-    both, rebuilding structure from a fresh ``init_state`` template."""
+    both, rebuilding structure from a fresh ``init_state`` template.
+
+    Mesh portability: checkpoints are MESH-INDEPENDENT. ``save_model``
+    gathers sharded leaves to full host arrays before serializing (the PR 3
+    manifest — leaf CRCs, tree fingerprint — never sees a layout), and
+    ``load_model`` re-shards the restored state onto whatever mesh THIS
+    learner carries — save on 8 devices, resume on 1/2/4 or a single
+    device, bit-exact either way (tests/test_mesh_checkpoint.py)."""
+
+    #: Whether this learner's step programs consume an MP (tensor-parallel)
+    #: state layout. Only MAML's arg-driven mp path does; the sequential
+    #: baselines pin fully replicated in/out shardings, so MP-sharding
+    #: their state at init/restore would just force a reshard copy back to
+    #: replicated on the first dispatch (and defeat donation).
+    supports_model_sharding = False
+
+    def state_shardings(self, state):
+        """``NamedSharding`` tree for a full train state under this
+        learner's mesh (``parallel/sharding.state_shardings`` rule tables:
+        replicated on dp meshes, the conv-channel MP rules when the mesh
+        has a model axis AND the learner's programs consume that layout —
+        ``supports_model_sharding``), or ``None`` without a mesh."""
+        mesh = getattr(self, "mesh", None)
+        if mesh is None:
+            return None
+        from ..parallel.mesh import DEFAULT_MODEL_AXIS
+        from ..parallel.sharding import state_shardings
+
+        shard_model = (
+            self.supports_model_sharding
+            and mesh.shape.get(DEFAULT_MODEL_AXIS, 1) > 1
+        )
+        return state_shardings(mesh, state, shard_model=shard_model)
+
+    def shard_state(self, state):
+        """Lays ``state`` out on this learner's mesh (async sharding-aware
+        ``device_put``); identity without a mesh."""
+        shardings = self.state_shardings(state)
+        if shardings is None:
+            return state
+        import jax
+
+        return jax.tree.map(
+            lambda leaf, sh: jax.device_put(leaf, sh), state, shardings
+        )
+
+    def gather_state(self, state):
+        """Sharded state -> full host numpy tree (one batched device_get —
+        the gather side of ``parallel/sharding.make_shard_and_gather_fns``,
+        batched because per-leaf fetches cost a device round trip each);
+        identity without a mesh."""
+        if getattr(self, "mesh", None) is None:
+            return state
+        from ..parallel.sharding import gather_tree
+
+        return gather_tree(state)
 
     def save_model(self, model_save_dir: str, state, experiment_state: dict) -> None:
         from ..utils.checkpoint import save_checkpoint
 
-        save_checkpoint(model_save_dir, state, experiment_state)
+        save_checkpoint(
+            model_save_dir, self.gather_state(state), experiment_state
+        )
 
     def load_model(self, model_save_dir: str, model_name: str, model_idx):
         import os
@@ -424,7 +481,10 @@ class CheckpointableLearner:
 
         filepath = os.path.join(model_save_dir, f"{model_name}_{model_idx}")
         template = self.init_state(jax.random.PRNGKey(0))
-        return load_checkpoint(filepath, template)
+        state, experiment_state = load_checkpoint(filepath, template)
+        # Re-shard onto THIS learner's mesh shape (which may differ from
+        # the writer's — the archive itself is layout-free).
+        return self.shard_state(state), experiment_state
 
     def load_inference_state(self, filepath: str):
         """Serving cold-start load: restores the learner's params+BN

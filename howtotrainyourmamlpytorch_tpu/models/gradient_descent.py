@@ -87,6 +87,21 @@ class GradientDescentLearner(CheckpointableLearner):
         # with the explicit epoch index, ``gradient_descent.py:206``).
         self.tx = make_injected_adam(cfg.meta_learning_rate, cfg.clip_grad_value)
 
+        # Mesh runs: explicit REPLICATED in/out shardings. This baseline's
+        # task loop is sequential weight mutation by design (the reference's
+        # whole point), so there is no task axis to shard — pinning the
+        # layout keeps mesh runs (staged batches, checkpoint re-sharding)
+        # consistent with the dp learners without pretending to scale.
+        jit_kwargs: dict = {}
+        if mesh is not None:
+            from ..parallel.mesh import replicated
+
+            rep = replicated(mesh)
+            jit_kwargs = dict(
+                in_shardings=(rep, rep), out_shardings=(rep, rep, rep)
+            )
+        self._mesh_jit_kwargs = jit_kwargs
+
         self._train_step = jax.jit(
             named_partial(
                 "gd_train_step", self._run_batch,
@@ -94,6 +109,7 @@ class GradientDescentLearner(CheckpointableLearner):
                 training=True,
             ),
             donate_argnums=(0,),
+            **jit_kwargs,
         )
         self._eval_step = jax.jit(
             named_partial(
@@ -102,7 +118,19 @@ class GradientDescentLearner(CheckpointableLearner):
                 training=False,
             ),
             donate_argnums=(0,),
+            **jit_kwargs,
         )
+
+    def staged_batch_sharding(self, group: int = 1):
+        """Stager contract (see maml.staged_batch_sharding): batches ride
+        replicated on mesh runs — the sequential task scan consumes the
+        whole batch on every device."""
+        del group
+        if self.mesh is None:
+            return None
+        from ..parallel.mesh import replicated
+
+        return replicated(self.mesh)
 
     def init_state(self, key: jax.Array) -> GDState:
         theta, bn_state = self.backbone.init(key)

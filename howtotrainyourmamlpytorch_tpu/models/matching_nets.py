@@ -103,13 +103,42 @@ class MatchingNetsLearner(CheckpointableLearner):
         self.mesh = mesh
         self.tx = make_injected_adam(cfg.meta_learning_rate, cfg.clip_grad_value)
 
+        # Mesh runs: explicit REPLICATED in/out shardings — the per-task
+        # Adam update makes the task loop sequential by design (matching
+        # the reference), so there is no task axis to shard; pinning the
+        # layout keeps staged batches and checkpoint re-sharding consistent
+        # with the dp learners. Eval keeps NO donation: the caller returns
+        # the same state object it passed in.
+        jit_kwargs: dict = {}
+        if mesh is not None:
+            from ..parallel.mesh import replicated
+
+            rep = replicated(mesh)
+            jit_kwargs = dict(
+                in_shardings=(rep, rep), out_shardings=(rep, rep, rep)
+            )
+        self._mesh_jit_kwargs = jit_kwargs
+
         self._train_step = jax.jit(
             named_partial("matching_train_step", self._run_batch, training=True),
             donate_argnums=(0,),
+            **jit_kwargs,
         )
         self._eval_step = jax.jit(
-            named_partial("matching_eval_step", self._run_batch, training=False)
+            named_partial("matching_eval_step", self._run_batch, training=False),
+            **jit_kwargs,
         )
+
+    def staged_batch_sharding(self, group: int = 1):
+        """Stager contract (see maml.staged_batch_sharding): batches ride
+        replicated on mesh runs — the sequential task scan consumes the
+        whole batch on every device."""
+        del group
+        if self.mesh is None:
+            return None
+        from ..parallel.mesh import replicated
+
+        return replicated(self.mesh)
 
     def init_state(self, key: jax.Array) -> MatchingNetsState:
         theta, bn_state = self.backbone.init(key)

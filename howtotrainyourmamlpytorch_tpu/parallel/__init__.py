@@ -19,6 +19,17 @@ from .mesh import (
     DEFAULT_DATA_AXIS,
     DEFAULT_MODEL_AXIS,
 )
+from .sharding import (
+    DP_STATE_RULES,
+    MP_STATE_RULES,
+    batch_sharding_spec,
+    gather_tree,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    shard_tree,
+    state_shardings,
+    tree_shardings,
+)
 from .distributed import initialize_distributed
 
 __all__ = [
@@ -30,4 +41,13 @@ __all__ = [
     "initialize_distributed",
     "DEFAULT_DATA_AXIS",
     "DEFAULT_MODEL_AXIS",
+    "DP_STATE_RULES",
+    "MP_STATE_RULES",
+    "batch_sharding_spec",
+    "gather_tree",
+    "make_shard_and_gather_fns",
+    "match_partition_rules",
+    "shard_tree",
+    "state_shardings",
+    "tree_shardings",
 ]

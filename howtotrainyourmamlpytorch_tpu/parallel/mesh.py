@@ -42,19 +42,34 @@ def make_mesh(
 
 
 def default_mesh_from_args(args) -> Mesh | None:
-    """Mesh for the CLI entry points: a ``dp``-only mesh over
-    ``data_parallel_devices`` (0 = all local) devices, or ``None`` on a
-    single device — the SPMD replacement for the reference's
-    if-multi-GPU-wrap-DataParallel (``few_shot_learning_system.py:73-81``).
-    The global meta-batch must divide over ``dp``."""
+    """Mesh for the CLI entry points: a ``(dp, mp)`` mesh over
+    ``data_parallel_devices`` x ``model_parallel_devices`` devices (dp 0 =
+    fill with all local devices), or ``None`` on a single device — the SPMD
+    replacement for the reference's if-multi-GPU-wrap-DataParallel
+    (``few_shot_learning_system.py:73-81``). The global meta-batch must
+    divide over ``dp``. ``model_parallel_devices > 1`` opts into the tensor
+    (conv-channel) rule set (``sharding.MP_STATE_RULES``) — fenced by
+    ``spmd_compile_guard`` on backends with the GSPMD conv CHECK-crash."""
     import jax as _jax
 
+    mp = int(getattr(args, "model_parallel_devices", 1) or 1)
     n = int(getattr(args, "data_parallel_devices", 0) or 0)
     devices = _jax.devices()
+    if mp < 1:
+        raise ValueError(f"model_parallel_devices must be >= 1, got {mp}")
     if n <= 0:
-        n = len(devices)
-    if n == 1:
+        n = len(devices) // mp
+        if n < 1:
+            raise ValueError(
+                f"model_parallel_devices {mp} exceeds the {len(devices)} "
+                "local device(s) — no dp extent fits"
+            )
+    if n * mp == 1:
         return None
+    if n * mp > len(devices):
+        raise ValueError(
+            f"mesh needs {n} x {mp} = {n * mp} devices, have {len(devices)}"
+        )
     # The loader's task axis is num_of_gpus * batch_size * samples_per_iter
     # episodes (data/loader.py global_batch).
     batch = (
@@ -64,9 +79,9 @@ def default_mesh_from_args(args) -> Mesh | None:
     )
     if batch % n != 0:
         raise ValueError(
-            f"global meta-batch {batch} not divisible by {n} mesh devices"
+            f"global meta-batch {batch} not divisible by {n} dp mesh devices"
         )
-    return make_mesh(devices[:n], data_parallel=n, model_parallel=1)
+    return make_mesh(devices[: n * mp], data_parallel=n, model_parallel=mp)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -113,49 +128,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 def param_shardings(mesh: Mesh, params: Any, shard_model: bool = False) -> Any:
     """Sharding tree for backbone parameters.
 
-    With ``shard_model`` the output-channel axis of conv filters goes over
-    ``mp`` (per-step BN gamma/beta follow their feature axis) and the linear
-    head is row-parallel: its input-feature axis is sharded, its bias
-    replicated, with XLA inserting the psum over partial products. Axes not
-    divisible by the ``mp`` size fall back to replication. Otherwise
-    everything is replicated.
+    Thin veneer over the declarative rule tables in ``parallel/sharding``
+    (the single source of truth for the layout policy): with
+    ``shard_model`` the ``MP_STATE_RULES`` conv-channel layout applies —
+    conv filters over ``mp`` output channels, BN gamma/beta on their
+    feature axis, layer-norm weight/bias on their leading channel axis,
+    the linear head row-parallel (XLA inserts the psum over partial
+    products) — with non-divisible axes falling back to replication.
+    Otherwise everything is replicated.
     """
-    if not shard_model:
-        return jax.tree.map(lambda _: replicated(mesh), params)
+    from .sharding import state_rules, tree_shardings
 
-    mp = mesh.shape[DEFAULT_MODEL_AXIS]
-
-    def guarded(leaf, ax: list) -> NamedSharding:
-        """Replicate instead of sharding an axis not divisible by |mp|."""
-        for i, name in enumerate(ax):
-            if name is not None and leaf.shape[i] % mp != 0:
-                ax[i] = None
-        return NamedSharding(mesh, P(*ax))
-
-    def spec(path: tuple[str, ...], leaf) -> NamedSharding:
-        if path[-2:] == ("conv", "weight"):
-            return guarded(leaf, [DEFAULT_MODEL_AXIS, None, None, None])
-        if path[-2:] == ("conv", "bias"):
-            return guarded(leaf, [DEFAULT_MODEL_AXIS])
-        if "norm" in path and leaf.ndim >= 1:
-            # BN gamma/beta: feature axis last ((F,) or per-step (S, F));
-            # layer-norm weight/bias: (C, H, W) with the channel axis FIRST —
-            # it must follow the conv's output-channel sharding.
-            ax = [None] * leaf.ndim
-            if path[-1] in ("gamma", "beta"):
-                ax[-1] = DEFAULT_MODEL_AXIS
-            else:
-                ax[0] = DEFAULT_MODEL_AXIS
-            return guarded(leaf, ax)
-        if path[-2:] == ("linear", "weight"):
-            # Row-parallel: shard the input-feature axis ((num_classes, feat)
-            # layout) — the class axis is tiny (e.g. 5), features are wide;
-            # XLA inserts the psum over partial products.
-            return guarded(leaf, [None, DEFAULT_MODEL_AXIS])
-        if path[-2:] == ("linear", "bias"):
-            return replicated(mesh)
-        return replicated(mesh)
-
-    from ..models.backbone import _map_with_path
-
-    return _map_with_path(spec, params)
+    return tree_shardings(mesh, params, state_rules(shard_model))

@@ -1,0 +1,230 @@
+"""Declarative sharding: regex partition rules -> spec trees -> shard/gather.
+
+The multi-chip layout policy lives here as DATA, not as code scattered
+through the learners: a rule table maps regex patterns over "/"-joined
+pytree key paths to :class:`~jax.sharding.PartitionSpec`s (the
+``match_partition_rules`` pattern of the large-model JAX trainers —
+SNIPPETS.md [1]/[3]). Because the patterns ``re.search`` the full path,
+one ``conv/weight$`` rule covers the backbone parameter AND its Adam
+moment mirrors inside the optax state (``opt_state/.../mu/theta/...``),
+which is what lets a whole ``TrainState`` be laid out from one table.
+
+Three consumers:
+
+* the learners' jitted step programs (``in_shardings``/``out_shardings``
+  built from the spec trees);
+* checkpointing: ``make_shard_and_gather_fns`` gives the gather side
+  (sharded device state -> host numpy in the PR 3 manifest format, which
+  is mesh-independent) and the shard side (restored host leaves ->
+  whatever mesh shape the resuming job runs — save on 8, resume on 1/2/4);
+* the device-prefetch stager's sharding-aware ``jax.device_put`` staging.
+
+Divisibility guard: an axis whose size does not divide its mesh-axis
+extent falls back to replication for that leaf (same policy as the
+original ``param_shardings``) — a 5-way linear head must not refuse an
+8-way ``mp`` mesh outright.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import (
+    DictKey,
+    FlattenedIndexKey,
+    GetAttrKey,
+    SequenceKey,
+    tree_flatten_with_path,
+)
+
+from .mesh import DEFAULT_DATA_AXIS, DEFAULT_MODEL_AXIS
+
+Tree = Any
+#: A rule is ``(pattern, spec)`` where ``spec`` is a PartitionSpec or a
+#: callable ``leaf -> PartitionSpec`` (for specs that depend on the leaf's
+#: rank, e.g. "shard the LAST axis").
+Rule = "tuple[str, P | Callable[[Any], P]]"
+
+
+def _path_entry_name(entry) -> str:
+    if isinstance(entry, DictKey):
+        return str(entry.key)
+    if isinstance(entry, SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, FlattenedIndexKey):
+        return str(entry.key)
+    return repr(entry)  # exotic custom node: best effort
+
+
+def tree_path_name(path) -> str:
+    """``tree_flatten_with_path`` key path -> ``"a/b/c"`` rule-match name."""
+    return "/".join(_path_entry_name(entry) for entry in path)
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree: Tree) -> Tree:
+    """``jax.tree.map`` with the leaf's "/"-joined key-path name."""
+    paths_and_leaves, treedef = tree_flatten_with_path(tree)
+    mapped = [
+        fn(tree_path_name(path), leaf) for path, leaf in paths_and_leaves
+    ]
+    return jax.tree.unflatten(treedef, mapped)
+
+
+def last_axis(axis_name: str) -> Callable[[Any], P]:
+    """Rule spec: shard the leaf's LAST axis (rank-dependent — per-step BN
+    gamma/beta are ``(S, F)`` while plain BN's are ``(F,)``, and the feature
+    axis is last in both)."""
+
+    def spec(leaf) -> P:
+        return P(*([None] * (np.ndim(leaf) - 1) + [axis_name]))
+
+    return spec
+
+
+def match_partition_rules(rules, tree: Tree) -> Tree:
+    """Spec tree from the FIRST rule whose pattern ``re.search``-matches
+    each leaf's "/"-joined key path. Scalar / single-element leaves are
+    never partitioned (``P()``); a leaf no rule matches is an error — a
+    silent replicate-by-omission would defeat the table being the single
+    source of truth (end every table with an explicit ``(".*", P())``)."""
+
+    def get_spec(name: str, leaf) -> P:
+        if np.ndim(leaf) == 0 or int(np.prod(np.shape(leaf))) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                return spec(leaf) if callable(spec) else spec
+        raise ValueError(f"no partition rule matched leaf {name!r}")
+
+    return named_tree_map(get_spec, tree)
+
+
+def guard_divisible(mesh: Mesh, spec: P, leaf) -> P:
+    """Replicates any spec axis whose leaf dimension does not divide the
+    mesh-axis extent (per-axis, not all-or-nothing)."""
+    shape = np.shape(leaf)
+    out = []
+    for i, axis in enumerate(spec):
+        if axis is not None and shape[i] % mesh.shape[axis] != 0:
+            axis = None
+        out.append(axis)
+    return P(*out)
+
+
+def tree_shardings(mesh: Mesh, tree: Tree, rules) -> Tree:
+    """``NamedSharding`` tree for ``tree`` under ``rules`` (divisibility-
+    guarded) — the form ``jax.device_put`` / ``in_shardings`` consume."""
+    specs = match_partition_rules(rules, tree)
+    return jax.tree.map(
+        lambda leaf, spec: NamedSharding(mesh, guard_divisible(mesh, spec, leaf)),
+        tree,
+        specs,
+    )
+
+
+def make_shard_and_gather_fns(mesh: Mesh, partition_specs: Tree):
+    """Per-leaf ``(shard_fns, gather_fns)`` from a spec tree.
+
+    ``shard_fns``: host/device leaf -> device array laid out on ``mesh``
+    (an async sharding-aware ``jax.device_put``; the divisibility guard is
+    applied against the actual leaf at call time).
+    ``gather_fns``: (possibly sharded) device leaf -> full host ``numpy``
+    array — the checkpoint save side; the result is independent of the mesh
+    the leaf lived on, which is what keeps the PR 3 manifest (leaf CRCs,
+    tree fingerprint) mesh-portable.
+    """
+
+    def make_shard_fn(spec):
+        def shard_fn(leaf):
+            return jax.device_put(
+                leaf, NamedSharding(mesh, guard_divisible(mesh, spec, leaf))
+            )
+
+        return shard_fn
+
+    def make_gather_fn(_spec):
+        def gather_fn(leaf):
+            return np.asarray(jax.device_get(leaf))
+
+        return gather_fn
+
+    shard_fns = jax.tree.map(make_shard_fn, partition_specs)
+    gather_fns = jax.tree.map(make_gather_fn, partition_specs)
+    return shard_fns, gather_fns
+
+
+def shard_tree(tree: Tree, shard_fns: Tree) -> Tree:
+    return jax.tree.map(lambda fn, leaf: fn(leaf), shard_fns, tree)
+
+
+def gather_tree(tree: Tree, gather_fns: Tree | None = None) -> Tree:
+    """Sharded state -> host numpy tree. Without explicit gather fns this
+    is ONE batched ``jax.device_get`` over the flattened leaves (a per-leaf
+    fetch costs a device round trip each — see utils/checkpoint)."""
+    if gather_fns is not None:
+        return jax.tree.map(lambda fn, leaf: fn(leaf), gather_fns, tree)
+    leaves, treedef = jax.tree.flatten(tree)
+    return jax.tree.unflatten(
+        treedef, [np.asarray(leaf) for leaf in jax.device_get(leaves)]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rule tables (the layout policy, as data)
+# ---------------------------------------------------------------------------
+
+#: Pure data parallelism: every state leaf replicated; the task axis of the
+#: batch carries the parallelism (see ``batch_rules``). The right table for
+#: backbone-scale models — the outer-gradient all-reduce over ICI is the
+#: only cross-chip traffic.
+DP_STATE_RULES = (
+    (r".*", P()),
+)
+
+#: Tensor ("mp") parallelism for the conv backbones (ResNet-12 / imagenet
+#: channel counts), matched ANYWHERE in the path so optimizer moments
+#: follow their parameters:
+#:
+#: * conv filters over output channels (axis 0); per-step BN gamma/beta
+#:   follow their feature axis (LAST — ``(F,)`` or per-step ``(S, F)``);
+#:   layer-norm weight/bias are ``(C, H, W)`` with the channel axis FIRST;
+#: * the linear head row-parallel over input features (the class axis is
+#:   tiny, features are wide; XLA inserts the psum over partial products);
+#: * LSLR tables and BN running stats replicated (small, and the per-task
+#:   fast weights ride mp-replicated anyway — ``mesh.mp_grad_anchor``).
+MP_STATE_RULES = (
+    (r"(^|/)lslr/", P()),
+    (r"(^|/)bn_state(/|$)", P()),
+    (r"conv/weight$", P(DEFAULT_MODEL_AXIS)),
+    (r"conv/bias$", P(DEFAULT_MODEL_AXIS)),
+    (r"norm/(gamma|beta)$", last_axis(DEFAULT_MODEL_AXIS)),
+    (r"norm/(weight|bias)$", P(DEFAULT_MODEL_AXIS)),
+    (r"linear/weight$", P(None, DEFAULT_MODEL_AXIS)),
+    (r"linear/bias$", P()),
+    (r".*", P()),
+)
+
+
+def state_rules(shard_model: bool):
+    """The rule table for a full learner train state."""
+    return MP_STATE_RULES if shard_model else DP_STATE_RULES
+
+
+def state_shardings(mesh: Mesh, state: Tree, shard_model: bool = False) -> Tree:
+    """``NamedSharding`` tree for a learner train state (params, LSLR, BN
+    stats, optimizer moments, counters) under the declared rule table."""
+    return tree_shardings(mesh, state, state_rules(shard_model))
+
+
+def batch_sharding_spec(mesh: Mesh, leading_scan_axis: bool = False):
+    """The episode-batch sharding: task axis over ``dp``. With
+    ``leading_scan_axis`` the arrays are the pre-stacked K-scan form
+    ``(K, B, ...)`` and the task axis sits second."""
+    spec = P(None, DEFAULT_DATA_AXIS) if leading_scan_axis else P(DEFAULT_DATA_AXIS)
+    return NamedSharding(mesh, spec)

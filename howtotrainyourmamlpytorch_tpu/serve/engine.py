@@ -167,6 +167,13 @@ class ServingEngine:
         #: ``/healthz`` reports 503 until this flips — a replica that has
         #: never produced logits must not attract traffic.
         self.ready = False
+        # Mesh attribution for serve_dispatch events (mirrors the trainer's
+        # step events). The engine's jitted program pair runs on ONE device
+        # today — stamp the actual span, not the host's device count (on a
+        # multi-device host they differ, and the field exists precisely to
+        # attribute throughput to topology). A future sharded-serving
+        # engine must raise this with its mesh size.
+        self._n_devices = 1
         self._adapt, self._classify = self._build_programs()
 
     # ------------------------------------------------------------------
@@ -411,6 +418,7 @@ class ServingEngine:
             cache_hits=len(eps) - len(miss),
             adapt_ms=adapt_ms,
             classify_ms=classify_ms,
+            n_devices=self._n_devices,
         )
         return [host[i] for i in range(len(eps))]
 

@@ -57,9 +57,25 @@ class TrainTelemetry:
         profile_trace_path: str = "",
         profile_num_iters: int = 20,
         profile_trigger_path: str = "",
+        n_devices: int = 1,
+        mesh_dp: int = 1,
+        mesh_mp: int = 1,
     ):
         self.enabled = bool(enabled)
         self.logs_dir = logs_dir
+        # Mesh attribution (multi-chip runs): stamped on every step event
+        # and the per-epoch summary keys, so a throughput regression is
+        # attributable to a topology change from the telemetry alone. The
+        # epoch-CSV columns stay NUMERIC (dp/mp extents, not a shape
+        # string) — pack_and_save_metrics float()s every epoch key.
+        self.n_devices = int(n_devices)
+        self.mesh_dp = int(mesh_dp)
+        self.mesh_mp = int(mesh_mp)
+        self.mesh_shape = (
+            f"dp{self.mesh_dp}xmp{self.mesh_mp}"
+            if self.n_devices > 1
+            else "single"
+        )
         self.events: EventLog | None = (
             EventLog(os.path.join(logs_dir, "telemetry.jsonl"))
             if self.enabled
@@ -190,6 +206,8 @@ class TrainTelemetry:
                     stage_wait_s=stage_wait_s,
                     staged=bool(staged),
                     device_s=device_s,
+                    n_devices=self.n_devices,
+                    mesh_shape=self.mesh_shape,
                 )
         self._last_dispatch_t = now
         self.profiler.tick(n_iters)
@@ -244,6 +262,12 @@ class TrainTelemetry:
                 f"{phase}_stage_wait_p50": float("nan"),
                 f"{phase}_stage_wait_p95": float("nan"),
             }
+        # Topology columns ride the same stable-schema contract: always
+        # present (numeric — the CSV packer float()s every key), so
+        # multichip and single-chip epochs stay comparable rows.
+        stats["n_devices"] = self.n_devices
+        stats["mesh_dp"] = self.mesh_dp
+        stats["mesh_mp"] = self.mesh_mp
         if self.events is not None:
             self.events.emit(
                 "epoch_summary",

@@ -158,7 +158,16 @@ def get_parser() -> argparse.ArgumentParser:
              "in-step transform (requires --transfer_dtype uint8). The "
              "host then ships raw uint8 pixels only")
     add("--data_parallel_devices", type=int, default=0,
-        help="0 = all local devices; shards the task axis over the mesh")
+        help="dp extent of the device mesh (0 = fill with all local "
+             "devices after model_parallel_devices); shards the task axis "
+             "of the meta-batch over 'dp' — parallel/sharding declares the "
+             "layout, the stager stages straight into it")
+    add("--model_parallel_devices", type=int, default=1,
+        help="mp extent of the device mesh (tensor parallelism): conv "
+             "filters sharded over output channels + row-parallel linear "
+             "head per parallel/sharding.MP_STATE_RULES. Default 1 (pure "
+             "dp). Fenced by the GSPMD conv-partitioner probe on broken "
+             "backends (tests/conftest.py::spmd_compile_guard)")
     add("--profile_trace_path", type=str, default="",
         help="when set, jax.profiler-trace the first profile_num_iters "
              "train iterations into this directory (also the base dir for "

@@ -63,45 +63,49 @@ def compile_guard():
 # backends (the TPU bench chip, fixed jaxlibs) the probe passes and every
 # sharded test runs normally.
 
-_SPMD_PROBE = textwrap.dedent(
-    """
-    import numpy as np, jax
-    from howtotrainyourmamlpytorch_tpu.utils.platform import force_virtual_cpu
-    force_virtual_cpu(2)
-    from howtotrainyourmamlpytorch_tpu.models import (
-        BackboneConfig, MAMLConfig, MAMLFewShotLearner,
-    )
-    from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
-
-    # Minimal reproducer of the crashing program class: dp-sharded
-    # second-order-capable MAML train step over a per-step-BN conv net.
-    cfg = MAMLConfig(
-        backbone=BackboneConfig(
-            num_stages=2, num_filters=4, per_step_bn_statistics=True,
-            num_steps=2, num_classes=5, image_height=8, image_width=8,
-        ),
-        number_of_training_steps_per_iter=2,
-        number_of_evaluation_steps_per_iter=2,
-    )
-    mesh = make_mesh(jax.devices()[:2], data_parallel=2, model_parallel=1)
-    learner = MAMLFewShotLearner(cfg, mesh=mesh)
-    state = learner.init_state(jax.random.PRNGKey(0))
-    rng = np.random.RandomState(0)
-    xs = rng.rand(2, 5, 1, 1, 8, 8).astype(np.float32)
-    ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1))
-    state, _ = learner.run_train_iter(
-        state, (xs, xs.copy(), ys, ys.copy()), epoch=0
-    )
-    jax.block_until_ready(state.theta)
-    print("SPMD_PROBE_OK")
-    """
+_SPMD_PROBE_TEMPLATE = """
+import numpy as np, jax
+from howtotrainyourmamlpytorch_tpu.utils.platform import force_virtual_cpu
+force_virtual_cpu(2)
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig, MAMLConfig, MAMLFewShotLearner,
 )
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+
+# Minimal reproducer of the crashing program class: a dp-sharded MAML
+# train step over a per-step-BN conv net (K=1 AND the K-scan dispatch).
+cfg = MAMLConfig(
+    backbone=BackboneConfig(
+        num_stages=2, num_filters=4, per_step_bn_statistics=True,
+        num_steps=2, num_classes=5, image_height=8, image_width=8,
+    ),
+    number_of_training_steps_per_iter=2,
+    number_of_evaluation_steps_per_iter=2,
+    second_order={second_order},
+)
+mesh = make_mesh(jax.devices()[:2], data_parallel=2, model_parallel=1)
+learner = MAMLFewShotLearner(cfg, mesh=mesh)
+state = learner.shard_state(learner.init_state(jax.random.PRNGKey(0)))
+rng = np.random.RandomState(0)
+xs = rng.rand(2, 5, 1, 1, 8, 8).astype(np.float32)
+ys = np.tile(np.arange(5)[None, :, None], (2, 1, 1))
+state, _ = learner.run_train_iter(
+    state, (xs, xs.copy(), ys, ys.copy()), epoch=0
+)
+batch = (xs, xs.copy(), ys, ys.copy())
+state, _ = learner.run_train_iters(state, [batch, batch], epoch=0)
+jax.block_until_ready(state.theta)
+print("SPMD_PROBE_OK")
+"""
 
 
-@pytest.fixture(scope="session")
-def spmd_compile_guard(tmp_path_factory):
+def _spmd_probe(tmp_path_factory, second_order: bool, what: str):
     script = tmp_path_factory.mktemp("spmd_probe") / "probe.py"
-    script.write_text(_SPMD_PROBE)
+    script.write_text(
+        textwrap.dedent(
+            _SPMD_PROBE_TEMPLATE.format(second_order=second_order)
+        )
+    )
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # the probe forces its own device count
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -117,8 +121,23 @@ def spmd_compile_guard(tmp_path_factory):
         detail = f"probe did not run: {exc}"
     if not ok:
         pytest.skip(
-            "XLA's CPU GSPMD partitioner aborts compiling sharded conv "
-            f"programs in this jaxlib ({detail}; known "
+            f"XLA's CPU GSPMD partitioner aborts compiling {what} sharded "
+            f"conv programs in this jaxlib ({detail}; known "
             "convolution_handler.cc:831 CHECK) — sharded-compile tests are "
             "guarded so the abort cannot truncate the suite"
         )
+
+
+@pytest.fixture(scope="session")
+def spmd_compile_guard(tmp_path_factory):
+    _spmd_probe(tmp_path_factory, second_order=True, what="second-order")
+
+
+@pytest.fixture(scope="session")
+def spmd_fo_compile_guard(tmp_path_factory):
+    """First-order variant of ``spmd_compile_guard``: the observed
+    CHECK-crash class is SECOND-ORDER-specific on some jaxlibs (this
+    container's included), so first-order dp-sharded tests get their own
+    probe — they run (and keep real mesh coverage) where the second-order
+    tests must skip, and still skip on backends broken for both."""
+    _spmd_probe(tmp_path_factory, second_order=False, what="first-order")

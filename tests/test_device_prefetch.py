@@ -304,10 +304,12 @@ def test_close_returns_promptly_when_producer_blocked_upstream():
         release.set()
 
 
-def test_builder_disables_stager_on_mesh_runs():
-    """Sharded runs pin in_shardings on the step programs; the stager's
-    bare single-device device_put would conflict. The builder must fall
-    back to the inline host loop whenever the learner carries a mesh."""
+def test_builder_mesh_staging_follows_learner_declaration():
+    """Mesh runs STAGE now (ISSUE 8 closed PR 7's gap) — but only when the
+    learner declares a staged-batch sharding; a learner that declines
+    (``None`` — the arg-driven mp layout) or predates the hook keeps the
+    inline host loop, and ``--device_prefetch 0`` still disables staging
+    everywhere."""
     from howtotrainyourmamlpytorch_tpu.experiment_builder import (
         ExperimentBuilder,
     )
@@ -317,8 +319,33 @@ def test_builder_disables_stager_on_mesh_runs():
 
     builder = Stub()
     builder.device_prefetch = -1
+    builder._use_multi = False
+    builder.iters_per_dispatch = 1
+    builder.state = {"current_iter": 0}
+    builder.args = Stub()
+    builder.args.total_iter_per_epoch = 4
     builder.model = Stub()
     builder.model.mesh = object()  # any active mesh
+    builder.model.cfg = Stub()
+    builder.model.cfg.wire_codec = None
+
+    # Learner declares a batch layout -> mesh-aware stager staging into it.
+    declared = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    builder.model.staged_batch_sharding = lambda group: declared
+    stager = ExperimentBuilder._make_stager(builder, iter(()))
+    try:
+        assert isinstance(stager, DevicePrefetcher)
+        assert stager._sharding is declared
+    finally:
+        stager.close()
+
+    # Learner declines (mp mesh: committed staged layout could force a
+    # reshard copy onto the critical path) -> inline host loop.
+    builder.model.staged_batch_sharding = lambda group: None
+    assert ExperimentBuilder._make_stager(builder, iter(())) is None
+
+    # Learner without the hook at all -> inline host loop on mesh runs.
+    del builder.model.staged_batch_sharding
     assert ExperimentBuilder._make_stager(builder, iter(())) is None
 
     builder.device_prefetch = 0

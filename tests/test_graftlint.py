@@ -480,3 +480,84 @@ def test_unused_suppression_is_a_violation():
 def test_parse_error_is_reported_not_raised():
     found = violations_of("def broken(:\n    pass\n")
     assert [v.rule for v in found] == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: rule coverage over the sharding-helper shapes (parallel/sharding)
+# ---------------------------------------------------------------------------
+
+
+def test_host_numpy_on_spec_helpers_outside_trace_is_clean():
+    """The declarative sharding helpers interrogate leaves with host numpy
+    (np.ndim/np.shape in rank-dependent specs and the divisibility guard)
+    OUTSIDE any traced function — that is their design and must stay
+    lint-clean."""
+    src = """
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    def last_axis(axis_name):
+        def spec(leaf):
+            return P(*([None] * (np.ndim(leaf) - 1) + [axis_name]))
+        return spec
+
+    def guard_divisible(mesh, spec, leaf):
+        shape = np.shape(leaf)
+        out = []
+        for i, axis in enumerate(spec):
+            if axis is not None and shape[i] % mesh.shape[axis] != 0:
+                axis = None
+            out.append(axis)
+        return P(*out)
+    """
+    assert "host-numpy-in-trace" not in rules_of(
+        src, path="pkg/parallel/sharding.py"
+    )
+
+
+def test_host_numpy_gather_inside_traced_function_flags():
+    """A gather helper (np.asarray on device values) belongs OUTSIDE the
+    trace — the same call inside a jitted step would bake the gathered
+    constant. The rule must catch a gather-shaped call migrating into a
+    traced function."""
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(state):
+        gathered = np.asarray(state)
+        return gathered * 2
+    """
+    assert "host-numpy-in-trace" in rules_of(
+        src, path="pkg/parallel/sharding.py"
+    )
+
+
+def test_device_op_mesh_aware_staging_does_not_widen_the_data_path_ban():
+    """Mesh-aware staging (ISSUE 8) hands the stager a Sharding as DATA —
+    it must not license new jax/jax.sharding imports across data/. A new
+    data/ module reaching for jax.sharding directly still flags; the
+    sharding helpers themselves live in parallel/, outside the ban."""
+    sharded_loader = """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def collate(mesh, episodes):
+        return NamedSharding(mesh, PartitionSpec("dp"))
+    """
+    assert "device-op-in-data-path" in rules_of(
+        sharded_loader, path="pkg/data/sharded_loader.py"
+    )
+    assert "device-op-in-data-path" not in rules_of(
+        sharded_loader, path="pkg/parallel/sharding.py"
+    )
+    # The allowlisted stager stays clean with the sharding-aware put form.
+    sharding_aware_put = """
+    import jax
+
+    def stage(batch, sharding):
+        return jax.device_put(batch, sharding)
+    """
+    assert "device-op-in-data-path" not in rules_of(
+        sharding_aware_put, path="pkg/data/device_prefetch.py"
+    )

@@ -1,0 +1,165 @@
+"""Mesh-portable checkpoints (ISSUE 8): save on one mesh shape, resume on
+another, bit-exact.
+
+``CheckpointableLearner.save_model`` gathers sharded leaves to full host
+arrays before serializing, so the archive (and its PR 3 manifest: per-leaf
+CRCs, tree fingerprint) is MESH-INDEPENDENT; ``load_model`` re-shards the
+restored state onto whatever mesh the RESUMING learner carries. Covered
+here: save under the 8-device mesh and restore under 4/2-device meshes and
+a single device (and the reverse), params bit-exact every way; the archive
+a mesh run writes is byte-for-byte the same manifest a single-device run
+writes for the same values; and the PR 3 corrupt/mismatch typed-error
+behavior is unchanged through the mesh path.
+
+No sharded CONV program is compiled anywhere here (``shard_state`` /
+``gather_state`` are layout ops, not program compiles), so these tests run
+on every backend — including jaxlibs whose GSPMD partitioner CHECK-crashes
+on sharded conv compiles.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    _MANIFEST_KEY,
+    CheckpointCorruptError,
+)
+
+
+def cfg(num_filters=4):
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=num_filters,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False,
+    )
+
+
+def dp_mesh(n):
+    return make_mesh(jax.devices()[:n], data_parallel=n, model_parallel=1)
+
+
+def learner_on(n_devices):
+    """A learner on an n-device dp mesh (None = single device)."""
+    mesh = dp_mesh(n_devices) if n_devices > 1 else None
+    return MAMLFewShotLearner(cfg(), mesh=mesh)
+
+
+def host_leaves(learner, state):
+    return [np.asarray(x) for x in jax.tree.leaves(learner.gather_state(state))]
+
+
+EXP = {"current_iter": 17, "best_val_acc": 0.5}
+
+
+@pytest.mark.parametrize("restore_devices", [1, 2, 4])
+def test_save_on_8_restore_on_other_mesh_shapes_bit_exact(
+    tmp_path, restore_devices
+):
+    writer = learner_on(8)
+    state = writer.shard_state(writer.init_state(jax.random.PRNGKey(5)))
+    path = os.path.join(tmp_path, "train_model_3")
+    writer.save_model(path, state, dict(EXP))
+
+    reader = learner_on(restore_devices)
+    restored, exp = reader.load_model(str(tmp_path), "train_model", 3)
+    assert exp == EXP
+    for a, b in zip(host_leaves(writer, state), host_leaves(reader, restored)):
+        np.testing.assert_array_equal(a, b)
+    if reader.mesh is not None:
+        # The restored state actually LIVES on the resuming mesh shape.
+        for leaf in jax.tree.leaves(restored):
+            assert isinstance(leaf.sharding, NamedSharding)
+            assert leaf.sharding.mesh.shape == reader.mesh.shape
+
+
+def test_save_single_device_restore_on_8_device_mesh(tmp_path):
+    """The reverse direction: a pre-mesh checkpoint resumes onto a mesh."""
+    writer = learner_on(1)
+    state = writer.init_state(jax.random.PRNGKey(6))
+    path = os.path.join(tmp_path, "train_model_0")
+    writer.save_model(path, state, dict(EXP))
+
+    reader = learner_on(8)
+    restored, _ = reader.load_model(str(tmp_path), "train_model", 0)
+    for a, b in zip(host_leaves(writer, state), host_leaves(reader, restored)):
+        np.testing.assert_array_equal(a, b)
+    for leaf in jax.tree.leaves(restored):
+        assert leaf.sharding.mesh.shape == reader.mesh.shape
+
+
+def test_archive_manifest_is_mesh_independent(tmp_path):
+    """The same state values produce the same manifest (leaf CRCs + tree
+    fingerprint) whether saved from a sharded or a single-device learner —
+    the fingerprint a resume verifies can never depend on the writer's
+    topology."""
+    single = learner_on(1)
+    state = single.init_state(jax.random.PRNGKey(9))
+    sharded = learner_on(8)
+    state_s = sharded.shard_state(state)
+
+    p1 = os.path.join(tmp_path, "train_model_1")
+    p8 = os.path.join(tmp_path, "train_model_8")
+    single.save_model(p1, state, dict(EXP))
+    sharded.save_model(p8, state_s, dict(EXP))
+
+    def manifest(path):
+        with np.load(path) as archive:
+            return json.loads(bytes(archive[_MANIFEST_KEY]).decode())
+
+    m1, m8 = manifest(p1), manifest(p8)
+    assert m1["leaf_crc32"] == m8["leaf_crc32"]
+    assert m1["tree_crc32"] == m8["tree_crc32"]
+    assert m1["leaf_count"] == m8["leaf_count"]
+
+
+def test_corrupt_archive_stays_typed_through_the_mesh_path(tmp_path):
+    """PR 3 contract unchanged: truncation surfaces as the quarantinable
+    ``CheckpointCorruptError`` (not a shard/layout error) when the READER
+    is a mesh learner."""
+    writer = learner_on(8)
+    state = writer.shard_state(writer.init_state(jax.random.PRNGKey(2)))
+    path = os.path.join(tmp_path, "train_model_2")
+    writer.save_model(path, state, dict(EXP))
+
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        learner_on(4).load_model(str(tmp_path), "train_model", 2)
+
+
+def test_architecture_mismatch_stays_valueerror_through_the_mesh_path(
+    tmp_path,
+):
+    """PR 3's corrupt-vs-mismatch split survives re-sharding: an archive
+    from a DIFFERENT architecture fails fast as ValueError before any
+    device_put happens."""
+    writer = learner_on(8)
+    state = writer.shard_state(writer.init_state(jax.random.PRNGKey(4)))
+    path = os.path.join(tmp_path, "train_model_7")
+    writer.save_model(path, state, dict(EXP))
+
+    mesh = dp_mesh(4)
+    other = MAMLFewShotLearner(cfg(num_filters=8), mesh=mesh)
+    with pytest.raises(ValueError) as err:
+        other.load_model(str(tmp_path), "train_model", 7)
+    assert not isinstance(err.value, CheckpointCorruptError)

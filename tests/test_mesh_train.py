@@ -1,0 +1,351 @@
+"""Mesh-aware device prefetch + dp-sharded dispatch paths (ISSUE 8).
+
+PR 7 disabled the stager on mesh runs (its bare single-device
+``device_put`` would fight the pinned ``in_shardings``). The mesh-aware
+stager closes that gap: staged arrays are ``device_put`` straight into the
+learner's declared batch sharding (``staged_batch_sharding``), so
+multi-chip runs keep the overlapped pipeline. Contracts, all on the
+8-device virtual CPU mesh (conftest):
+
+* staged == inline BIT-EXACT on the mesh, on the K=1 AND the K-scan
+  dispatch path (staging is a layout-aware transfer, not a program change);
+* the dp-sharded step programs compile exactly once with the stager active
+  and the staged loop issues zero ``jax.device_get`` — the PR 2
+  compile-once and PR 5 zero-new-syncs invariants hold on mesh runs;
+* dp-sharded training from the same init matches single-device training at
+  equal global meta-batch (meta-gradients compared under float-reassociation
+  tolerances — the ``test_sharding.py`` precedent);
+* the ``staged_batch_sharding`` declaration contract across all three
+  learners: task axis over ``dp`` for MAML (second axis on the K-scan
+  form), replicated for the sequential baselines, ``None`` (decline —
+  inline host loop) without a mesh and on mp meshes, where the arg-driven
+  theta layout must not be fought by a committed staged layout.
+
+First-order programs under ``spmd_fo_compile_guard``: the GSPMD conv
+CHECK-crash some jaxlibs carry (convolution_handler.cc:831) is
+SECOND-ORDER-specific, so these tests keep real mesh coverage on backends
+where the second-order sharded tests must skip.
+"""
+
+import jax
+import pytest
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from howtotrainyourmamlpytorch_tpu.data.device_prefetch import (
+    DevicePrefetcher,
+)
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    GradientDescentLearner,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    MatchingNetsLearner,
+)
+from howtotrainyourmamlpytorch_tpu.models.common import (
+    StagedBatch,
+    WireCodec,
+    prepare_batch,
+)
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+    DEFAULT_DATA_AXIS,
+)
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("second_order", False)
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        wire_codec=WireCodec(1.0, None, None),
+        **kw,
+    )
+
+
+def dp_mesh(n=8):
+    return make_mesh(jax.devices()[:n], data_parallel=n, model_parallel=1)
+
+
+def make_samples(rng, n, tasks=8):
+    """n loader-layout samples whose task axis divides the 8-way dp mesh."""
+    samples = []
+    for i in range(n):
+        xs = rng.randint(0, 2, (tasks, 5, 1, 1, 8, 8)).astype(np.float32)
+        xt = rng.randint(0, 2, (tasks, 5, 1, 1, 8, 8)).astype(np.float32)
+        ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(
+            np.int32
+        )
+        samples.append((xs, xt, ys, ys.copy(), np.full(tasks, 100 + i)))
+    return samples
+
+
+def stage_all(samples, learner, group):
+    stager = DevicePrefetcher(
+        iter(samples),
+        lambda b: prepare_batch(b, codec=learner.cfg.wire_codec),
+        depth=2,
+        group=group,
+        sharding=learner.staged_batch_sharding(group),
+    )
+    try:
+        return list(stager)
+    finally:
+        stager.close()
+
+
+# ---------------------------------------------------------------------------
+# Bit-exactness: staged == inline on the mesh
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_staged_k1_training_bitwise_identical(spmd_fo_compile_guard):
+    rng = np.random.RandomState(0)
+    samples = make_samples(rng, 5)
+    mesh = dp_mesh()
+    learner = MAMLFewShotLearner(tiny_cfg(), mesh=mesh)
+    s_inline = learner.shard_state(learner.init_state(jax.random.PRNGKey(7)))
+    s_staged = learner.shard_state(learner.init_state(jax.random.PRNGKey(7)))
+
+    for sample in samples:
+        s_inline, _ = learner.run_train_iter(s_inline, sample[:4], epoch=0)
+
+    staged = stage_all(samples, learner, group=1)
+    assert [b.n_iters for b in staged] == [1] * 5
+    for batch in staged:
+        assert isinstance(batch, StagedBatch)
+        # The staged arrays arrived already laid out for the pinned
+        # in_shardings: task axis over 'dp', on THIS mesh.
+        sh = batch.arrays[0].sharding
+        assert isinstance(sh, NamedSharding)
+        assert sh.mesh.shape == mesh.shape
+        assert sh.spec == P(DEFAULT_DATA_AXIS)
+        s_staged, _ = learner.run_train_iter(s_staged, batch, epoch=0)
+
+    for a, b in zip(jax.tree.leaves(s_inline), jax.tree.leaves(s_staged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mesh_staged_k_scan_bitwise_identical(spmd_fo_compile_guard):
+    """group=K stages whole pre-stacked scan dispatches, laid out with the
+    task axis SECOND (after the leading K axis) per the learner's K-scan
+    in_shardings."""
+    rng = np.random.RandomState(1)
+    samples = make_samples(rng, 7)
+    mesh = dp_mesh()
+    learner = MAMLFewShotLearner(tiny_cfg(), mesh=mesh)
+    s_inline = learner.shard_state(learner.init_state(jax.random.PRNGKey(9)))
+    s_staged = learner.shard_state(learner.init_state(jax.random.PRNGKey(9)))
+
+    for chunk in (samples[:3], samples[3:6], samples[6:]):
+        s_inline, _ = learner.run_train_iters(
+            s_inline, [c[:4] for c in chunk], epoch=0
+        )
+
+    staged = stage_all(samples, learner, group=3)
+    assert [b.n_iters for b in staged] == [3, 3, 1]
+    for batch in staged:
+        sh = batch.arrays[0].sharding
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == P(None, DEFAULT_DATA_AXIS)
+        s_staged, _ = learner.run_train_iters(s_staged, batch, epoch=0)
+
+    for a, b in zip(jax.tree.leaves(s_inline), jax.tree.leaves(s_staged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Compile-exactly-once + zero host syncs with the stager active on the mesh
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_staged_k1_compiles_once_zero_syncs(
+    compile_guard, spmd_fo_compile_guard
+):
+    """One warm inline dispatch, then a staged loop on the mesh: the
+    dp-sharded step program must compile exactly once TOTAL (staged arrays
+    present the identical signature AND layout) and the staged loop must
+    trigger zero jax.device_get."""
+    rng = np.random.RandomState(3)
+    samples = make_samples(rng, 6)
+    learner = MAMLFewShotLearner(tiny_cfg(), mesh=dp_mesh())
+    state = learner.shard_state(learner.init_state(jax.random.PRNGKey(11)))
+
+    with compile_guard() as guard:
+        state, _ = learner.run_train_iter(state, samples[0][:4], epoch=0)
+        jax.block_until_ready(state.theta)
+
+        device_gets = {"n": 0}
+        real_device_get = jax.device_get
+
+        def counting_device_get(x):
+            device_gets["n"] += 1
+            return real_device_get(x)
+
+        jax.device_get = counting_device_get
+        try:
+            staged = stage_all(samples[1:], learner, group=1)
+            for batch in staged:
+                state, _ = learner.run_train_iter(state, batch, epoch=0)
+            jax.block_until_ready(state.theta)
+        finally:
+            jax.device_get = real_device_get
+    guard.assert_compiles("_train_step", exactly=1)
+    guard.assert_unique_signatures("_train_step")
+    assert device_gets["n"] == 0
+
+
+def test_mesh_staged_k_scan_compiles_once(compile_guard, spmd_fo_compile_guard):
+    rng = np.random.RandomState(4)
+    samples = make_samples(rng, 9)
+    learner = MAMLFewShotLearner(tiny_cfg(), mesh=dp_mesh())
+    state = learner.shard_state(learner.init_state(jax.random.PRNGKey(13)))
+    with compile_guard() as guard:
+        state, _ = learner.run_train_iters(
+            state, [s[:4] for s in samples[:3]], epoch=0
+        )
+        staged = stage_all(samples[3:], learner, group=3)
+        for batch in staged:
+            state, _ = learner.run_train_iters(state, batch, epoch=0)
+        jax.block_until_ready(state.theta)
+    guard.assert_compiles("multi", exactly=1)
+    guard.assert_unique_signatures("multi")
+
+
+# ---------------------------------------------------------------------------
+# dp-sharded vs single-device parity at equal global meta-batch
+# ---------------------------------------------------------------------------
+
+
+def test_dp_first_order_meta_grads_match_single_device(spmd_fo_compile_guard):
+    """The first-order dp path (the program class that survives GSPMD-broken
+    partitioners, and bench.py's fallback measurement program) produces the
+    single-device meta-gradient at equal global meta-batch — sharding is a
+    layout change, compared under reassociation tolerances (see the
+    ``test_sharding._meta_grads`` note on why grads, not post-Adam params)."""
+    rng = np.random.RandomState(5)
+    batch = make_samples(rng, 1)[0][:4]
+    cfg = tiny_cfg()
+    ref = MAMLFewShotLearner(cfg)
+    state = ref.init_state(jax.random.PRNGKey(3))
+    prepared = ref._prepare_batch(batch)
+    importance = jnp.asarray(ref._train_importance(100))
+
+    def meta_grads(learner, st, prep, imp):
+        def f(outer, bn, b, i):
+            loss, _ = learner._meta_loss(
+                outer, bn, b, i, 2, False, None, True
+            )
+            return loss
+
+        outer = {"theta": st.theta, "lslr": st.lslr}
+        return jax.jit(jax.grad(f))(outer, st.bn_state, prep, imp)
+
+    ref_grads = meta_grads(ref, state, prepared, importance)
+
+    mesh = dp_mesh()
+    learner = MAMLFewShotLearner(cfg, mesh=mesh)
+    state_s = learner.shard_state(state)
+    prepared_s = tuple(
+        jax.device_put(
+            jnp.asarray(p), NamedSharding(mesh, P(DEFAULT_DATA_AXIS))
+        )
+        for p in prepared
+    )
+    dp_grads = meta_grads(learner, state_s, prepared_s, importance)
+
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(dp_grads)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# staged_batch_sharding declaration contract
+# ---------------------------------------------------------------------------
+
+
+def test_staged_batch_sharding_contract():
+    """Pure declarations — no sharded conv program is compiled, so this
+    runs on every backend (no spmd guard)."""
+    cfg = tiny_cfg()
+    mesh = dp_mesh()
+
+    # No mesh: decline (the stager's plain single-device put is correct).
+    assert MAMLFewShotLearner(cfg).staged_batch_sharding(1) is None
+
+    # dp mesh: task axis over 'dp'; second axis on the K-scan form.
+    maml = MAMLFewShotLearner(cfg, mesh=mesh)
+    sh1 = maml.staged_batch_sharding(1)
+    assert isinstance(sh1, NamedSharding)
+    assert sh1.spec == P(DEFAULT_DATA_AXIS)
+    shk = maml.staged_batch_sharding(3)
+    assert shk.spec == P(None, DEFAULT_DATA_AXIS)
+
+    # mp mesh: the arg-driven theta layout drives the program — decline,
+    # the builder keeps the inline host loop there.
+    mp_mesh = make_mesh(jax.devices()[:4], data_parallel=2, model_parallel=2)
+    assert MAMLFewShotLearner(cfg, mesh=mp_mesh).staged_batch_sharding(1) is None
+
+    # Sequential baselines: whole batch replicated on mesh runs, declined
+    # without a mesh.
+    for cls in (GradientDescentLearner, MatchingNetsLearner):
+        assert cls(cfg).staged_batch_sharding(1) is None
+        sh = cls(cfg, mesh=mesh).staged_batch_sharding(1)
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == P()
+
+
+def test_default_mesh_from_args_refuses_oversized_mp_cleanly():
+    """``--model_parallel_devices`` larger than the host must raise the
+    explanatory ValueError, not a ZeroDivisionError from a 0-dp extent
+    (dp default 0 = fill: len(devices) // mp == 0 there)."""
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        default_mesh_from_args,
+    )
+
+    class Args:
+        data_parallel_devices = 0
+        model_parallel_devices = 16  # > the 8 virtual devices
+        batch_size = 8
+
+    with pytest.raises(ValueError, match="exceeds"):
+        default_mesh_from_args(Args())
+
+
+def test_sequential_learners_state_stays_replicated_on_mp_meshes():
+    """gd/matching pin fully replicated in/out shardings on their step
+    programs, so their state must NOT be laid out by MP_STATE_RULES on an
+    mp mesh — that would force a reshard copy back to replicated on the
+    first dispatch (and defeat donation). Only MAML declares
+    ``supports_model_sharding``."""
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import replicated
+
+    cfg = tiny_cfg()
+    mp_mesh = make_mesh(jax.devices()[:4], data_parallel=2, model_parallel=2)
+    rep = replicated(mp_mesh)
+    for cls in (GradientDescentLearner, MatchingNetsLearner):
+        learner = cls(cfg, mesh=mp_mesh)
+        assert not learner.supports_model_sharding
+        state = learner.init_state(jax.random.PRNGKey(0))
+        shardings = learner.state_shardings(state)
+        assert all(
+            sh == rep for sh in jax.tree.leaves(shardings)
+        ), "sequential learner state must ride replicated on mp meshes"
+    maml = MAMLFewShotLearner(cfg, mesh=mp_mesh)
+    assert maml.supports_model_sharding
+    mp_specs = [
+        sh.spec for sh in jax.tree.leaves(maml.state_shardings(maml.init_state(
+            jax.random.PRNGKey(0))))
+    ]
+    assert any(any(ax is not None for ax in sp) for sp in mp_specs)

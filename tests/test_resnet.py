@@ -182,14 +182,38 @@ def test_mp_sharding_rules_cover_resnet_tree():
         make_mesh, param_shardings,
     )
 
+    from jax.sharding import NamedSharding
+
     bb = build_backbone(resnet_cfg(num_filters=4))
     params, _ = bb.init(jax.random.key(0))
     mesh = make_mesh(jax.devices()[:4], data_parallel=2, model_parallel=2)
     shardings = param_shardings(mesh, params, shard_model=True)
-    assert shardings["res0"]["conv0"]["conv"]["weight"].spec == P("mp", None, None, None)
-    assert shardings["res0"]["conv0"]["norm"]["gamma"].spec == P(None, "mp")
-    assert shardings["res0"]["shortcut"]["conv"]["weight"].spec == P("mp", None, None, None)
-    assert shardings["linear"]["weight"].spec == P(None, "mp")
+
+    def same_layout(sharding, spec, leaf):
+        # The declarative rule tables emit rank-truncated specs (P('mp')
+        # leaves trailing axes replicated) — compare LAYOUTS, not tuples.
+        return sharding.is_equivalent_to(
+            NamedSharding(mesh, spec), leaf.ndim
+        )
+
+    w = params["res0"]["conv0"]["conv"]["weight"]
+    assert same_layout(
+        shardings["res0"]["conv0"]["conv"]["weight"],
+        P("mp", None, None, None), w,
+    )
+    assert same_layout(
+        shardings["res0"]["conv0"]["norm"]["gamma"],
+        P(None, "mp"), params["res0"]["conv0"]["norm"]["gamma"],
+    )
+    assert same_layout(
+        shardings["res0"]["shortcut"]["conv"]["weight"],
+        P("mp", None, None, None),
+        params["res0"]["shortcut"]["conv"]["weight"],
+    )
+    assert same_layout(
+        shardings["linear"]["weight"], P(None, "mp"),
+        params["linear"]["weight"],
+    )
 
 
 def test_dp_sharded_train_iter_runs(rng, spmd_compile_guard):

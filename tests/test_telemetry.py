@@ -488,3 +488,94 @@ def test_telemetry_flag_off_writes_no_jsonl(dataset_env):
     stats = storage.load_statistics(str(tmp / "exp" / "logs"))
     assert "train_step_time_p50" in stats
     assert "train_data_wait_p50" in stats
+
+
+# ---------------------------------------------------------------------------
+# Mesh attribution (ISSUE 8): topology on step events + epoch CSV + report
+# ---------------------------------------------------------------------------
+
+
+def test_step_events_and_epoch_stats_carry_mesh_topology(tmp_path):
+    """Multichip runs stamp every step event with ``n_devices``/
+    ``mesh_shape`` and the epoch summary with NUMERIC ``n_devices``/
+    ``mesh_dp``/``mesh_mp`` columns (``pack_and_save_metrics`` float()s
+    every epoch key — a shape STRING would crash the CSV writer), so a
+    throughput regression is attributable to a topology change from the
+    telemetry alone."""
+    telemetry = TrainTelemetry(
+        str(tmp_path), enabled=True, n_devices=8, mesh_dp=8, mesh_mp=1
+    )
+    telemetry.record_dispatch(1, n_iters=1)
+    telemetry.record_dispatch(2, n_iters=1)
+    stats = telemetry.epoch_stats("train", epoch=0)
+    assert stats["n_devices"] == 8
+    assert stats["mesh_dp"] == 8
+    assert stats["mesh_mp"] == 1
+    for key in ("n_devices", "mesh_dp", "mesh_mp"):
+        float(stats[key])  # the CSV packer's contract
+    telemetry.flush()
+    events = read_events(os.path.join(str(tmp_path), "telemetry.jsonl"))
+    step = next(e for e in events if e["type"] == "step")
+    assert step["n_devices"] == 8
+    assert step["mesh_shape"] == "dp8xmp1"
+
+
+def test_single_device_topology_defaults_keep_rows_comparable(tmp_path):
+    """Single-chip runs carry the same columns (1 / "single"), so multichip
+    and single-chip epochs stay comparable CSV rows under the stable-schema
+    contract — including the <2-dispatch NaN path."""
+    telemetry = TrainTelemetry(str(tmp_path), enabled=True)
+    stats = telemetry.epoch_stats("train", epoch=0)  # zero dispatches
+    assert stats["n_devices"] == 1
+    assert stats["mesh_dp"] == 1
+    assert stats["mesh_mp"] == 1
+    assert telemetry.mesh_shape == "single"
+
+
+def test_report_surfaces_mesh_topology():
+    """tools/telemetry_report reads the topology off the step events
+    themselves (pre-mesh logs default to 1 device / "single")."""
+    from tools.telemetry_report import render_text, summarize
+
+    def step(i, **kw):
+        return {
+            "type": "step", "t": float(i), "iter": i, "k": 1,
+            "step_s": 0.1, "data_wait_s": 0.0, "stage_wait_s": 0.0,
+            "device_s": 0.1, **kw,
+        }
+
+    summary = summarize(
+        [step(1, n_devices=8, mesh_shape="dp8xmp1"),
+         step(2, n_devices=8, mesh_shape="dp8xmp1")]
+    )
+    assert summary["n_devices"] == 8
+    assert summary["mesh_shape"] == "dp8xmp1"
+    assert "8 device(s)" in render_text(summary)
+    assert "dp8xmp1" in render_text(summary)
+
+    legacy = summarize([step(1), step(2)])  # pre-mesh event log
+    assert legacy["n_devices"] == 1
+    assert legacy["mesh_shape"] == "single"
+
+
+def test_serve_dispatch_events_carry_n_devices(tmp_path):
+    """The serving engine stamps ``n_devices`` on serve_dispatch events
+    with the span its programs actually run on — 1 today, even on a
+    multi-device host (this test runs under the 8-device conftest mesh, so
+    it would catch ``len(jax.local_devices())`` misattribution); a future
+    sharded-serving engine raises it with its mesh size."""
+    from test_serve_runtime import episode, make_engine
+
+    log = EventLog(os.path.join(str(tmp_path), "telemetry.jsonl"))
+    prev = telemetry_events.install(log)
+    try:
+        engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+        ep = engine.prepare_episode(*episode(np.random.RandomState(0)))
+        engine.dispatch([ep])
+        log.flush()
+    finally:
+        telemetry_events.install(prev)
+    events = read_events(os.path.join(str(tmp_path), "telemetry.jsonl"))
+    dispatch = next(e for e in events if e["type"] == "serve_dispatch")
+    assert dispatch["n_devices"] == 1
+    assert len(jax.local_devices()) > 1  # host count would misattribute

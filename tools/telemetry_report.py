@@ -120,9 +120,19 @@ def summarize(events: list[dict]) -> dict:
     counts: dict[str, int] = {}
     for e in events:
         counts[e.get("type", "?")] = counts.get(e.get("type", "?"), 0) + 1
+    # Mesh attribution (multichip runs): the topology the steps ran on,
+    # from the step events themselves (pre-mesh logs default to 1/single).
+    n_devices = max(
+        (int(e.get("n_devices", 1)) for e in steps), default=1
+    )
+    mesh_shapes = sorted(
+        {str(e.get("mesh_shape", "single")) for e in steps}
+    ) or ["single"]
     return {
         "schema": SCHEMA_VERSION,
         "iters": len(per_iter["step"]),
+        "n_devices": n_devices,
+        "mesh_shape": "+".join(mesh_shapes),
         "breakdown": breakdown,
         "compiles": compiles,
         "events": log,
@@ -134,7 +144,9 @@ def render_text(summary: dict) -> str:
     lines = []
     lines.append(
         f"telemetry report — {summary['iters']} train iterations, "
-        f"schema v{summary['schema']}"
+        f"schema v{summary['schema']}, "
+        f"{summary.get('n_devices', 1)} device(s) "
+        f"[{summary.get('mesh_shape', 'single')}]"
     )
     lines.append("")
     lines.append("step-time breakdown (per iteration)")

@@ -3,7 +3,10 @@
 
 from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
-from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    default_mesh_from_args,
+    initialize_distributed,
+)
 from howtotrainyourmamlpytorch_tpu.models import GradientDescentLearner
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
 from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
@@ -14,7 +17,9 @@ from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
 if __name__ == "__main__":
     initialize_distributed()  # no-op without explicit multi-host env signal
     args, device = get_args()
-    model = GradientDescentLearner(cfg=args_to_maml_config(args))
+    model = GradientDescentLearner(
+        cfg=args_to_maml_config(args), mesh=default_mesh_from_args(args)
+    )
     maybe_unzip_dataset(args)
     system = ExperimentBuilder(
         model=model, data=MetaLearningSystemDataLoader, args=args, device=device

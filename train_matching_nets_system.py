@@ -3,7 +3,10 @@
 
 from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
-from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    default_mesh_from_args,
+    initialize_distributed,
+)
 from howtotrainyourmamlpytorch_tpu.models import MatchingNetsLearner
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
 from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
@@ -16,6 +19,7 @@ if __name__ == "__main__":
     args, device = get_args()
     model = MatchingNetsLearner(
         cfg=args_to_maml_config(args),
+        mesh=default_mesh_from_args(args),
         parity_bug=bool(getattr(args, "parity_bug", False)),
     )
     maybe_unzip_dataset(args)
