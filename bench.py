@@ -730,26 +730,44 @@ def main() -> None:
         im_epoch,
     )
 
-    # Same north-star program with the second-order-capable fused Pallas
-    # norm stack on the train path (fused_norm_train, optionally + the
-    # fused max-pool epilogue) — the regime is activation-traffic bound at
-    # ~3.8% MFU, and these two keys track whether the fused stack moves it
-    # (PERF_NOTES.md "Second-order fused normalization stack").
-    def _im_fused_rate(**backbone_kwargs):
-        cfg_v = dataclasses.replace(
-            imagenet_cfg,
-            backbone=dataclasses.replace(
-                imagenet_cfg.backbone, **backbone_kwargs
-            ),
-        )
+    # North-star de-bottlenecking A/B (ISSUE 9): the same program with each
+    # lever flipped alone, plus all levers together — the regime is
+    # normalization/elementwise-traffic bound at ~3.8% MFU, and these keys
+    # are what the next quiet-chip run reads to settle keep/revert per
+    # lever (PERF_NOTES.md "North-star de-bottlenecking").
+    def _im_variant_rate(backbone_kwargs=None, **cfg_kwargs):
+        cfg_v = imagenet_cfg
+        if backbone_kwargs:
+            cfg_v = dataclasses.replace(
+                cfg_v,
+                backbone=dataclasses.replace(
+                    cfg_v.backbone, **backbone_kwargs
+                ),
+            )
+        if cfg_kwargs:
+            cfg_v = dataclasses.replace(cfg_v, **cfg_kwargs)
         value_v, *_rest = _measure(
             cfg_v, repeats=30, batch_size=2, shots=5, targets_per_class=15
         )
         return value_v
 
-    im_fused_value = _im_fused_rate(fused_norm_train=True)
-    im_fused_pool_value = _im_fused_rate(
-        fused_norm_train=True, fused_norm_pool=True
+    im_fused_value = _im_variant_rate({"fused_norm_train": True})
+    im_fused_pool_value = _im_variant_rate(
+        {"fused_norm_train": True, "fused_norm_pool": True}
+    )
+    # Lane-padded compute layout (48 -> 64 channels, ops/layout.py).
+    im_lane_pad_value = _im_variant_rate({"lane_pad_channels": True})
+    # bf16 compute/activations with f32 masters (CPU backends EMULATE bf16,
+    # so this rate only means something on the quiet-chip row).
+    im_bf16_value = _im_variant_rate(compute_dtype="bfloat16")
+    # Task-axis memory policy: scan task chunks of 1 instead of the full
+    # vmap (the HBM-spill diagnosis knob for the meta-batch-8 pathology).
+    im_task_chunk_value = _im_variant_rate(task_chunk=1)
+    # All levers together — the candidate default for the regime.
+    im_all_levers_value = _im_variant_rate(
+        {"fused_norm_train": True, "lane_pad_channels": True},
+        compute_dtype="bfloat16",
+        task_chunk=1,
     )
 
     real = _measure_real_data()
@@ -861,13 +879,28 @@ def main() -> None:
                     round(im_value * im_flops / chip_peak_flops, 6)
                     if im_flops else None
                 ),
-                # Second-order fused norm stack on the same program
-                # (flags off by default pending a >=1.1x quiet-chip win).
+                # North-star de-bottlenecking A/B keys (ISSUE 9): one key
+                # per lever on the same program, plus the all-levers
+                # composition — flags off by default pending the quiet-chip
+                # keep/revert decision (>=1.1x per lever; the aggregate
+                # target is >=2x — PERF_NOTES.md).
                 "imagenet_shape_fused_train_meta_iters_per_s": round(
                     im_fused_value, 2
                 ),
                 "imagenet_shape_fused_train_pool_meta_iters_per_s": round(
                     im_fused_pool_value, 2
+                ),
+                "imagenet_shape_lane_pad_meta_iters_per_s": round(
+                    im_lane_pad_value, 2
+                ),
+                "imagenet_shape_bf16_meta_iters_per_s": round(
+                    im_bf16_value, 2
+                ),
+                "imagenet_shape_task_chunk_meta_iters_per_s": round(
+                    im_task_chunk_value, 2
+                ),
+                "imagenet_shape_all_levers_meta_iters_per_s": round(
+                    im_all_levers_value, 2
                 ),
                 # Multi-chip dp-sharded scale-out (weak scaling, per-device
                 # task load fixed): headline rate at the largest measured

@@ -44,5 +44,13 @@ def init_lslr(
 
 def lslr_update(params: Tree, grads: Tree, lslr: Tree, step) -> Tree:
     """One LSLR step: ``w' = w - lslr[step] * g`` per leaf
-    (``inner_loop_optimizers.py:108-113``). ``step`` may be traced."""
-    return jax.tree.map(lambda w, g, lr: w - lr[step] * g, params, grads, lslr)
+    (``inner_loop_optimizers.py:108-113``). ``step`` may be traced.
+
+    The result keeps each leaf's dtype: under the bf16 compute path the
+    fast weights are bf16 while the LSLR table stays f32, so the update
+    math runs in f32 (master-style — jnp promotion) and rounds back to the
+    compute dtype; for f32 fast weights the trailing cast is the identity
+    and the op is bit-for-bit the original."""
+    return jax.tree.map(
+        lambda w, g, lr: (w - lr[step] * g).astype(w.dtype), params, grads, lslr
+    )

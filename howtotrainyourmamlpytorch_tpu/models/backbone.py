@@ -45,6 +45,7 @@ from ..ops import (
     linear,
     max_pool2d,
     xavier_uniform,
+    zero_pad_to,
 )
 from ..ops.norm import BatchNormState, init_batch_norm_state
 
@@ -107,6 +108,27 @@ class BackboneConfig:
     use_pallas_fused_norm: bool = False
     fused_norm_train: bool = False
     fused_norm_pool: bool = False
+    # Lane-padded compute layout (ops/layout.py, --lane_pad_channels): conv
+    # channel dims padded up to the 128-lane-friendly width (48 -> 64) with
+    # structurally-zero filters/biases; the linear head slices features back
+    # to the real count, so logits are the unpadded program's bit for bit
+    # and every padded leaf's gradient is exactly zero. Checkpoints never
+    # contain padding (CheckpointableLearner strips on save, re-pads on
+    # load). Supported for batch_norm + conv_norm ordering (the shipped
+    # architectures — VGG and ResNet-12); a no-op at already-lane-friendly
+    # widths (the 64-filter flagship).
+    lane_pad_channels: bool = False
+
+    @property
+    def conv_channels(self) -> int:
+        """The COMPUTE-layout conv width: ``num_filters``, lane-padded up
+        when ``lane_pad_channels`` (``feature_dim`` and the head keep the
+        real ``num_filters`` — padding never reaches the logits)."""
+        if self.lane_pad_channels:
+            from ..ops.layout import lane_padded_width
+
+            return lane_padded_width(self.num_filters)
+        return self.num_filters
 
     @property
     def conv_stride(self) -> int:
@@ -164,26 +186,45 @@ class VGGBackbone:
         cfg = self.cfg
         if cfg.block_order not in ("conv_norm", "norm_conv"):
             raise ValueError(f"unknown block_order {cfg.block_order!r}")
+        if cfg.lane_pad_channels and (
+            cfg.block_order != "conv_norm" or cfg.norm_layer != "batch_norm"
+        ):
+            # The zero-padding equivalence proof covers per-channel BN after
+            # the conv (padding lanes normalize to beta = 0). layer_norm
+            # mixes channels (padding zeros would shift every statistic) and
+            # norm_conv normalizes the stage INPUT.
+            raise ValueError(
+                "lane_pad_channels requires norm_layer='batch_norm' and "
+                "block_order='conv_norm' (the zero-channel equivalence "
+                f"argument; got {cfg.norm_layer!r}/{cfg.block_order!r})"
+            )
         params: Params = {}
         bn_state: Params = {}
-        in_ch = cfg.image_channels
+        # Real widths drive the init RNG draws (a padded and an unpadded
+        # backbone from the same key agree bit-for-bit on the real slice);
+        # padded widths drive the stored shapes.
+        in_ch = in_ch_padded = cfg.image_channels
+        f_real, f_pad = cfg.num_filters, cfg.conv_channels
         keys = jax.random.split(key, cfg.num_stages + 1)
 
         for i in range(cfg.num_stages):
             stage: Params = {
                 "conv": {
-                    "weight": xavier_uniform(
-                        keys[i],
-                        (cfg.num_filters, in_ch, cfg.kernel_size, cfg.kernel_size),
-                        dtype,
+                    "weight": zero_pad_to(
+                        xavier_uniform(
+                            keys[i],
+                            (f_real, in_ch, cfg.kernel_size, cfg.kernel_size),
+                            dtype,
+                        ),
+                        (f_pad, in_ch_padded, cfg.kernel_size, cfg.kernel_size),
                     ),
-                    "bias": jnp.zeros((cfg.num_filters,), dtype),
+                    "bias": jnp.zeros((f_pad,), dtype),
                 }
             }
             # norm_conv normalizes the stage INPUT (C7's ordering,
             # meta_neural_network_architectures.py:474-487), so the feature
             # count/shape follows in_ch rather than the conv output.
-            norm_ch = in_ch if cfg.block_order == "norm_conv" else cfg.num_filters
+            norm_ch = in_ch if cfg.block_order == "norm_conv" else f_pad
             if cfg.norm_layer == "batch_norm":
                 affine_shape = (
                     (cfg.num_steps, norm_ch)
@@ -209,7 +250,7 @@ class VGGBackbone:
                     "bias": jnp.zeros((norm_ch, h, w), dtype),
                 }
             params[f"conv{i}"] = stage
-            in_ch = cfg.num_filters
+            in_ch, in_ch_padded = f_real, f_pad
 
         params["linear"] = {
             "weight": xavier_uniform(keys[-1], (cfg.num_classes, cfg.feature_dim), dtype),
@@ -347,6 +388,12 @@ class VGGBackbone:
         if not cfg.max_pooling:
             out = avg_pool2d(out, out.shape[2])
 
+        # Lane padding never reaches the head: slice the channel axis back
+        # to the real width (padded channels are structurally zero, so the
+        # sliced features — and their gradients — are the unpadded
+        # program's exactly; the head weight keeps its unpadded shape).
+        if out.shape[1] != cfg.num_filters:
+            out = out[:, : cfg.num_filters]
         out = out.reshape(out.shape[0], -1)
         logits = linear(out, params["linear"]["weight"], params["linear"]["bias"])
         return logits, new_bn_state

@@ -36,6 +36,27 @@ def named_partial(name: str, fn, *args, **kwargs):
     return bound
 
 
+def cast_floats(tree, dtype):
+    """Casts every floating leaf of ``tree`` to ``dtype`` — the train
+    step's ONE boundary cast of the f32 master parameters to the compute
+    dtype (``MAMLConfig.compute_dtype``). A no-op (the identity, not even
+    a traced cast) for float32, so f32 programs stay byte-identical.
+
+    Masters are the ``TrainState`` leaves themselves: they stay f32 in the
+    state and the optimizer, gradients flow back through this cast to f32
+    (``astype`` transposes to a cast), and Adam updates run in f32 — bf16
+    touches compute and activations only. Integer leaves (labels,
+    counters) ride through untouched."""
+    if dtype == jnp.float32:
+        return tree
+    return jax.tree.map(
+        lambda leaf: leaf.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+        else leaf,
+        tree,
+    )
+
+
 def nonfinite_flag(*values) -> jax.Array:
     """``0.0`` when every entry of every value is finite, else ``1.0`` —
     the divergence sentinel's trip signal, computed on-device inside the
@@ -465,12 +486,69 @@ class CheckpointableLearner:
 
         return gather_tree(state)
 
+    # -- lane-padded compute layout (ops/layout.py) --------------------
+    #
+    # Archives NEVER contain channel padding: a lane-padded learner
+    # (``BackboneConfig.lane_pad_channels``) strips its state back to the
+    # unpadded layout before serialization and re-embeds restored leaves
+    # into the padded template (whose padding lanes carry the canonical
+    # init values) on load. Checkpoints therefore stay mesh- AND
+    # layout-portable: padded and unpadded writers/readers interoperate
+    # bit-exactly on the real channels (tests/test_layout_padding.py).
+
+    def _lane_pad_templates(self, init_fn_name: str):
+        """``(unpadded_template, padded_template)`` trees for the state
+        built by ``init_fn_name`` when lane padding actually changes leaf
+        shapes for this learner, else ``None``. Cached per learner."""
+        cache = getattr(self, "_lane_pad_template_cache", None)
+        if cache is None:
+            cache = self._lane_pad_template_cache = {}
+        if init_fn_name not in cache:
+            result = None
+            bb = getattr(self.cfg, "backbone", None)
+            if bb is not None and getattr(bb, "lane_pad_channels", False):
+                import dataclasses
+
+                import jax
+
+                from ..ops.layout import trees_same_shapes
+
+                twin_cfg = dataclasses.replace(
+                    self.cfg,
+                    backbone=dataclasses.replace(bb, lane_pad_channels=False),
+                )
+                twin = type(self)(twin_cfg)
+                key = jax.random.PRNGKey(0)
+                # The unpadded template is only ever read for shapes/
+                # dtypes/structure (strip_tree slicing, checkpoint prefix
+                # restore), so abstract-trace it and materialize host
+                # zeros — no device allocation, no init compile.
+                unpadded = jax.eval_shape(getattr(twin, init_fn_name), key)
+                padded = getattr(self, init_fn_name)(key)
+                if not trees_same_shapes(unpadded, padded):
+                    # pad_tree DOES read the padded template's values
+                    # (canonical padding-lane init) — cache it on the
+                    # host so no device copy stays resident between
+                    # checkpoint events.
+                    result = (
+                        jax.tree.map(
+                            lambda s: np.zeros(s.shape, s.dtype), unpadded
+                        ),
+                        jax.device_get(padded),
+                    )
+            cache[init_fn_name] = result
+        return cache[init_fn_name]
+
     def save_model(self, model_save_dir: str, state, experiment_state: dict) -> None:
         from ..utils.checkpoint import save_checkpoint
 
-        save_checkpoint(
-            model_save_dir, self.gather_state(state), experiment_state
-        )
+        state = self.gather_state(state)
+        templates = self._lane_pad_templates("init_state")
+        if templates is not None:
+            from ..ops.layout import strip_tree
+
+            state = strip_tree(state, templates[0])
+        save_checkpoint(model_save_dir, state, experiment_state)
 
     def load_model(self, model_save_dir: str, model_name: str, model_idx):
         import os
@@ -480,11 +558,42 @@ class CheckpointableLearner:
         from ..utils.checkpoint import load_checkpoint
 
         filepath = os.path.join(model_save_dir, f"{model_name}_{model_idx}")
-        template = self.init_state(jax.random.PRNGKey(0))
+        templates = self._lane_pad_templates("init_state")
+        template = (
+            templates[0]
+            if templates is not None
+            else self.init_state(jax.random.PRNGKey(0))
+        )
         state, experiment_state = load_checkpoint(filepath, template)
+        if templates is not None:
+            from ..ops.layout import pad_tree
+
+            state = pad_tree(state, templates[1])
         # Re-shard onto THIS learner's mesh shape (which may differ from
         # the writer's — the archive itself is layout-free).
         return self.shard_state(state), experiment_state
+
+    def _load_inference_prefix(self, filepath: str):
+        """Shared serving cold-start prefix load: params+BN template,
+        layout-aware (archives are unpadded; a lane-padded learner re-pads
+        the restored slice). Returns ``(inference_state,
+        experiment_state)``."""
+        import jax
+
+        from ..utils.checkpoint import load_for_inference
+
+        templates = self._lane_pad_templates("init_inference_state")
+        template = (
+            templates[0]
+            if templates is not None
+            else self.init_inference_state(jax.random.PRNGKey(0))
+        )
+        loaded, experiment_state = load_for_inference(filepath, template)
+        if templates is not None:
+            from ..ops.layout import pad_tree
+
+            loaded = pad_tree(loaded, templates[1])
+        return loaded, experiment_state
 
     def load_inference_state(self, filepath: str):
         """Serving cold-start load: restores the learner's params+BN
@@ -493,9 +602,4 @@ class CheckpointableLearner:
         Returns ``(inference_state, experiment_state)``. Learners with
         serve-time state beyond the checkpoint prefix override this (GD
         attaches the epoch-schedule fine-tune lr)."""
-        import jax
-
-        from ..utils.checkpoint import load_for_inference
-
-        template = self.init_inference_state(jax.random.PRNGKey(0))
-        return load_for_inference(filepath, template)
+        return self._load_inference_prefix(filepath)

@@ -39,6 +39,7 @@ from .common import (
     CheckpointableLearner,
     InferenceState,
     StagedBatch,
+    cast_floats,
     cosine_epoch_lr,
     decode_images,
     decode_train_batch,
@@ -157,9 +158,15 @@ class GradientDescentLearner(CheckpointableLearner):
         backbone = self.backbone
         # uint8 wire decode (cast / descale / normalize, plus the on-device
         # train augmentation when the batch carries an aug operand) — see
-        # WireCodec / DeviceAugment in models/common.
+        # WireCodec / DeviceAugment in models/common. Activations follow
+        # the compute dtype (bf16 under --compute_dtype bfloat16); theta
+        # stays the f32 master — GD's theta IS the continuously-trained
+        # state, so the boundary cast sits at each backbone application
+        # (cast_floats, the identity at f32) and fine-tune grads/Adam run
+        # f32 on the masters.
+        compute_dtype = self.cfg.dtype
         xs_b, xt_b, ys_b, yt_b = decode_train_batch(
-            batch, self.cfg.wire_codec, jnp.float32,
+            batch, self.cfg.wire_codec, self.cfg.dtype,
             self.cfg.device_augment if training else None,
         )
 
@@ -171,7 +178,9 @@ class GradientDescentLearner(CheckpointableLearner):
                 theta, bn, opt_state = inner_carry
 
                 def support_loss_fn(theta_):
-                    logits, bn1 = backbone.apply(theta_, bn, xs, 0)
+                    logits, bn1 = backbone.apply(
+                        cast_floats(theta_, compute_dtype), bn, xs, 0
+                    )
                     return cross_entropy(logits, ys), bn1
 
                 (_, bn), grads = jax.value_and_grad(
@@ -185,7 +194,9 @@ class GradientDescentLearner(CheckpointableLearner):
             )
 
             def target_loss_fn(theta_):
-                logits, bn1 = backbone.apply(theta_, bn, xt, 0)
+                logits, bn1 = backbone.apply(
+                    cast_floats(theta_, compute_dtype), bn, xt, 0
+                )
                 return cross_entropy(logits, yt), (logits, bn1)
 
             (t_loss, (t_logits, bn)), grads = jax.value_and_grad(
@@ -193,8 +204,13 @@ class GradientDescentLearner(CheckpointableLearner):
             )(theta)
             theta, opt_state = self._update(grads, opt_state, theta)
             acc = accuracy(t_logits, yt)
+            # Logits leave the step in f32 regardless of the compute dtype
+            # — the builder's test ensemble AVERAGES them across models,
+            # and bf16's ~3 digits would degrade the ensemble argmax (same
+            # contract as MAML's final_logits and serve_classify).
             return (theta, bn, opt_state), (
-                t_loss, acc, t_logits, optax.global_norm(grads)
+                t_loss, acc, t_logits.astype(jnp.float32),
+                optax.global_norm(grads),
             )
 
         (theta, bn, opt_state), (t_losses, accs, logits, grad_norms) = lax.scan(
@@ -308,10 +324,7 @@ class GradientDescentLearner(CheckpointableLearner):
         """Serving cold-start load: the params+BN prefix plus the epoch-
         schedule fine-tune lr recomputed from the checkpoint's recorded
         ``current_iter`` — the value training injected that epoch."""
-        from ..utils.checkpoint import load_for_inference
-
-        template = self.init_inference_state(jax.random.PRNGKey(0))
-        loaded, experiment_state = load_for_inference(filepath, template)
+        loaded, experiment_state = self._load_inference_prefix(filepath)
         epoch = int(
             int(experiment_state.get("current_iter", 0))
             / max(int(self.cfg.total_iter_per_epoch), 1)
@@ -330,7 +343,7 @@ class GradientDescentLearner(CheckpointableLearner):
         """ONE task's support fine-tune (the eval step count), returning the
         adapted full parameter tree — this baseline's cacheable artifact."""
         backbone = self.backbone
-        x_support = decode_images(x_support, self.cfg.wire_codec, jnp.float32)
+        x_support = decode_images(x_support, self.cfg.wire_codec, self.cfg.dtype)
         opt_state = self.tx.init(istate.theta)
         # The injected-Adam lr is state, not config: overwrite the freshly
         # initialized hyperparam with the served rate (same mechanism as
@@ -343,7 +356,11 @@ class GradientDescentLearner(CheckpointableLearner):
             theta, bn, opt_state = carry
 
             def support_loss_fn(theta_):
-                logits, bn1 = backbone.apply(theta_, bn, x_support, 0)
+                # Same boundary cast as the train loop (identity at f32),
+                # so served fine-tuning matches run_validation_iter.
+                logits, bn1 = backbone.apply(
+                    cast_floats(theta_, self.cfg.dtype), bn, x_support, 0
+                )
                 return cross_entropy(logits, y_support), bn1
 
             (_, bn), grads = jax.value_and_grad(
@@ -362,6 +379,8 @@ class GradientDescentLearner(CheckpointableLearner):
 
     def serve_classify(self, istate: GDInferenceState, adapted, x_query):
         """ONE task's query forward with the fine-tuned weights."""
-        x_query = decode_images(x_query, self.cfg.wire_codec, jnp.float32)
-        logits, _ = self.backbone.apply(adapted, istate.bn_state, x_query, 0)
+        x_query = decode_images(x_query, self.cfg.wire_codec, self.cfg.dtype)
+        logits, _ = self.backbone.apply(
+            cast_floats(adapted, self.cfg.dtype), istate.bn_state, x_query, 0
+        )
         return logits.astype(jnp.float32)

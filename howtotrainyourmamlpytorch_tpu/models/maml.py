@@ -51,6 +51,7 @@ from .common import (
     DeviceAugment,
     StagedBatch,
     WireCodec,
+    cast_floats,
     cosine_epoch_lr,
     decode_augment_images,
     decode_images,
@@ -107,6 +108,16 @@ class MAMLConfig:
     # TPU-specific
     remat_inner_steps: bool = True
     compute_dtype: str = "float32"  # "bfloat16" runs the net in bf16 on the MXU
+    # Task-axis memory policy (--task_chunk): scan the meta-batch in chunks
+    # of N tasks instead of vmapping all tasks at once, bounding live
+    # activations to chunk x per-task — the meta-batch-8 HBM-spill
+    # diagnosis knob (PERF_NOTES.md "North-star de-bottlenecking"). 0 =
+    # full vmap. The chunk must divide the meta-batch size (a static shape,
+    # checked at trace time) and, on a dp mesh, be a multiple of the dp
+    # extent (parallel/sharding.guard_task_chunk). Bit-exact within
+    # reassociation vs the full vmap: the per-task math is identical, only
+    # the outer-grad accumulation order changes.
+    task_chunk: int = 0
     # uint8 image wire format (models/common.WireCodec): 4x less host->device
     # transfer bandwidth AND 4x slower axon-tunnel staging-buffer leak
     # (PERF_NOTES.md), bit-exact for the datasets that opt in.
@@ -153,6 +164,18 @@ class MAMLConfig:
                 " number_of_training_steps_per_iter"
                 f" ({self.number_of_training_steps_per_iter}) by at most 1"
                 " (the LSLR table has training_steps + 1 rows)"
+            )
+        if self.task_chunk < 0:
+            raise ValueError(
+                f"task_chunk must be >= 0, got {self.task_chunk}"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            # The dtype property maps any non-"bfloat16" value to f32, so
+            # an unvalidated typo would silently train at full precision.
+            raise ValueError(
+                "compute_dtype must be float32 | bfloat16 (resolve 'auto'"
+                " via utils.parser_utils.resolve_compute_dtype), got"
+                f" {self.compute_dtype!r}"
             )
 
 
@@ -234,10 +257,16 @@ class MAMLFewShotLearner(CheckpointableLearner):
         self._eval_jit_kwargs: dict = {}
         self._multi_jit_kwargs: dict = {}
         self._inner_grad_anchor = None
+        # --task_chunk on a dp mesh: the in-program layout constraint for
+        # the chunked scan form (scan axis replicated, chunk axis over
+        # 'dp') — see _meta_loss and parallel/sharding.
+        self._chunk_sharding = None
         if mesh is not None:
             from ..parallel.mesh import DEFAULT_MODEL_AXIS, mp_grad_anchor
-            from ..parallel.sharding import batch_sharding_spec
+            from ..parallel.sharding import batch_sharding_spec, guard_task_chunk
             from ..parallel.mesh import replicated
+
+            guard_task_chunk(mesh, cfg.task_chunk)
 
             if mesh.shape.get(DEFAULT_MODEL_AXIS, 1) > 1:
                 # Tensor-parallel: theta is laid out by the caller
@@ -256,6 +285,10 @@ class MAMLFewShotLearner(CheckpointableLearner):
                 # by the caller's host fetch).
                 rep = replicated(mesh)
                 dp_batch = batch_sharding_spec(mesh)
+                if cfg.task_chunk > 0:
+                    from ..parallel.sharding import chunked_batch_sharding
+
+                    self._chunk_sharding = chunked_batch_sharding(mesh)
                 self._train_jit_kwargs = dict(
                     in_shardings=(rep, dp_batch, rep),
                     out_shardings=(rep, rep),
@@ -540,6 +573,16 @@ class MAMLFewShotLearner(CheckpointableLearner):
         mask = backbone.inner_loop_mask(theta)
         adapt0, frozen = partition(theta, mask)
         compute_dtype = self.cfg.dtype
+        # ONE boundary cast of the f32 master params to the compute dtype
+        # (models/common.cast_floats — the identity at f32): under bf16 the
+        # whole inner loop — fast weights, inner grads, activations — runs
+        # in bf16, halving the activation bytes that bound the north-star
+        # regime; outer grads flow back through the cast to the f32 masters
+        # and Adam stays f32. The LSLR table and BN statistics stay f32
+        # (lslr_update computes in f32 and rounds; batch_norm always takes
+        # f32 statistics).
+        adapt0 = cast_floats(adapt0, compute_dtype)
+        frozen = cast_floats(frozen, compute_dtype)
         # Wire decode + optional on-device train augmentation (``aug`` is
         # the per-task operand of cfg.device_augment; eval batches never
         # carry one, so those programs reduce to the plain decode).
@@ -659,11 +702,61 @@ class MAMLFewShotLearner(CheckpointableLearner):
             outer_grad=outer_grad,
         )
         aug_axis = 0 if aug is not None else None
-        weighted, aux = jax.vmap(
+        vmapped = jax.vmap(
             per_task,
             in_axes=(None, None, None, 0, 0, 0, 0, None, aug_axis),
-        )(outer["theta"], outer["lslr"], bn_state, xs, ys, xt, yt, importance,
-          aug)
+        )
+        num_tasks = xs.shape[0]
+        chunk = self.cfg.task_chunk
+        if 0 < chunk < num_tasks:
+            # Task-axis memory policy (--task_chunk): scan chunk-sized
+            # slices of the task axis through the SAME vmapped program
+            # instead of materializing every task's inner-loop activations
+            # at once — live activations (and their second-order backward)
+            # are bounded by chunk x per-task, the HBM-spill lever for
+            # large meta-batches. The per-task math is identical; only the
+            # outer-grad accumulation order across chunks changes
+            # (reassociation), and results are re-flattened to the full
+            # (B, ...) task axis so every consumer is chunk-oblivious.
+            if num_tasks % chunk != 0:
+                raise ValueError(
+                    f"task_chunk ({chunk}) must divide the meta-batch's "
+                    f"task count ({num_tasks})"
+                )
+            n_chunks = num_tasks // chunk
+
+            def to_chunks(arr):
+                arr = arr.reshape((n_chunks, chunk) + arr.shape[1:])
+                if self._chunk_sharding is not None:
+                    arr = jax.lax.with_sharding_constraint(
+                        arr, self._chunk_sharding
+                    )
+                return arr
+
+            def chunk_body(_, chunk_batch):
+                cxs, cxt, cys, cyt, caug = chunk_batch
+                return None, vmapped(
+                    outer["theta"], outer["lslr"], bn_state,
+                    cxs, cys, cxt, cyt, importance, caug,
+                )
+
+            _, (weighted, aux) = lax.scan(
+                chunk_body,
+                None,
+                (
+                    to_chunks(xs), to_chunks(xt), to_chunks(ys),
+                    to_chunks(yt), to_chunks(aug) if aug is not None else None,
+                ),
+            )
+            weighted = weighted.reshape((num_tasks,) + weighted.shape[2:])
+            aux = jax.tree.map(
+                lambda a: a.reshape((num_tasks,) + a.shape[2:]), aux
+            )
+        else:
+            weighted, aux = vmapped(
+                outer["theta"], outer["lslr"], bn_state, xs, ys, xt, yt,
+                importance, aug,
+            )
         # Mean over tasks (few_shot_learning_system.py:164)
         return jnp.mean(weighted), aux
 
@@ -879,6 +972,10 @@ class MAMLFewShotLearner(CheckpointableLearner):
         backbone = self.backbone
         mask = backbone.inner_loop_mask(istate.theta)
         adapt0, frozen = partition(istate.theta, mask)
+        # Same boundary cast as the eval graph (_task_adapt_and_losses), so
+        # served adaptation stays bit-exact with run_validation_iter.
+        adapt0 = cast_floats(adapt0, self.cfg.dtype)
+        frozen = cast_floats(frozen, self.cfg.dtype)
         x_support = decode_images(x_support, self.cfg.wire_codec, self.cfg.dtype)
         fused = "vjp" if backbone.cfg.use_pallas_fused_norm else "off"
 
@@ -911,6 +1008,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         backbone = self.backbone
         mask = backbone.inner_loop_mask(istate.theta)
         _, frozen = partition(istate.theta, mask)
+        frozen = cast_floats(frozen, self.cfg.dtype)
         x_query = decode_images(x_query, self.cfg.wire_codec, self.cfg.dtype)
         fused = "vjp" if backbone.cfg.use_pallas_fused_norm else "off"
         logits, _ = backbone.apply(

@@ -48,6 +48,7 @@ from .common import (
     CheckpointableLearner,
     InferenceState,
     StagedBatch,
+    cast_floats,
     cosine_epoch_lr,
     decode_images,
     decode_train_batch,
@@ -186,8 +187,18 @@ class MatchingNetsLearner(CheckpointableLearner):
         )
 
     def _task_loss(self, theta, bn, xs, ys, xt, yt):
+        # Boundary cast of the f32 masters to the compute dtype (identity
+        # at f32): the embedding forwards run bf16, outer grads flow back
+        # through the cast, Adam stays f32.
+        theta = cast_floats(theta, self.cfg.dtype)
         support_emb, bn1 = self.backbone.apply(theta, bn, xs, 0)
         target_emb, bn2 = self.backbone.apply(theta, bn1, xt, 0)
+        # Similarity/attention/NLL in f32 regardless of the compute dtype:
+        # the embedding forwards carry the bf16 win; the tiny head math is
+        # precision-sensitive (softmax over similarities, log of mixed
+        # probabilities). No-op casts at f32.
+        support_emb = support_emb.astype(jnp.float32)
+        target_emb = target_emb.astype(jnp.float32)
         preds = self._predictions(support_emb, target_emb, ys)
         if self.parity_bug:
             log_probs = jax.nn.log_softmax(preds, axis=-1)
@@ -213,7 +224,7 @@ class MatchingNetsLearner(CheckpointableLearner):
         # train augmentation when the batch carries an aug operand) — see
         # WireCodec / DeviceAugment in models/common.
         xs_b, xt_b, ys_b, yt_b = decode_train_batch(
-            batch, self.cfg.wire_codec, jnp.float32,
+            batch, self.cfg.wire_codec, self.cfg.dtype,
             self.cfg.device_augment if training else None,
         )
 
@@ -312,9 +323,15 @@ class MatchingNetsLearner(CheckpointableLearner):
 
     def serve_adapt(self, istate: InferenceState, x_support, y_support):
         """ONE task's support embedding — adaptation-free 'adapt'."""
-        x_support = decode_images(x_support, self.cfg.wire_codec, jnp.float32)
-        emb, _ = self.backbone.apply(istate.theta, istate.bn_state, x_support, 0)
-        return {"support_emb": emb, "support_labels": y_support}
+        x_support = decode_images(x_support, self.cfg.wire_codec, self.cfg.dtype)
+        emb, _ = self.backbone.apply(
+            cast_floats(istate.theta, self.cfg.dtype), istate.bn_state,
+            x_support, 0,
+        )
+        return {
+            "support_emb": emb.astype(jnp.float32),
+            "support_labels": y_support,
+        }
 
     def serve_classify(self, istate: InferenceState, adapted, x_query):
         """ONE task's attention classify against the cached support
@@ -322,10 +339,13 @@ class MatchingNetsLearner(CheckpointableLearner):
         ``run_validation_iter`` reports (BN stats never affect outputs, so
         embedding queries with the template state matches the eval graph's
         support-evolved state bit-for-bit)."""
-        x_query = decode_images(x_query, self.cfg.wire_codec, jnp.float32)
+        x_query = decode_images(x_query, self.cfg.wire_codec, self.cfg.dtype)
         target_emb, _ = self.backbone.apply(
-            istate.theta, istate.bn_state, x_query, 0
+            cast_floats(istate.theta, self.cfg.dtype), istate.bn_state,
+            x_query, 0,
         )
         return self._predictions(
-            adapted["support_emb"], target_emb, adapted["support_labels"]
+            adapted["support_emb"],
+            target_emb.astype(jnp.float32),
+            adapted["support_labels"],
         ).astype(jnp.float32)

@@ -39,7 +39,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..ops import batch_norm, conv2d, linear, max_pool2d, xavier_uniform
+from ..ops import (
+    batch_norm,
+    conv2d,
+    linear,
+    max_pool2d,
+    xavier_uniform,
+    zero_pad_to,
+)
 from ..ops.norm import init_batch_norm_state
 from .backbone import (
     BackboneConfig,
@@ -72,15 +79,29 @@ class ResNet12Backbone:
         self.cfg = cfg
 
     @property
-    def widths(self) -> tuple[int, int, int, int]:
+    def real_widths(self) -> tuple[int, int, int, int]:
+        """Logical stage widths — what the head consumes and checkpoints
+        record."""
         if self.cfg.resnet_widths is not None:
             return tuple(self.cfg.resnet_widths)
         f = self.cfg.num_filters
         return (f, 2 * f, 4 * f, 8 * f)
 
     @property
+    def widths(self) -> tuple[int, int, int, int]:
+        """COMPUTE-layout stage widths: ``real_widths``, lane-padded when
+        ``lane_pad_channels`` (ops/layout.py; the MetaOptNet 160/320 widths
+        pad to 256/384 — padding lanes are structurally zero and the head
+        slices back to ``real_widths[-1]``)."""
+        if self.cfg.lane_pad_channels:
+            from ..ops.layout import lane_padded_width
+
+            return tuple(lane_padded_width(w) for w in self.real_widths)
+        return self.real_widths
+
+    @property
     def feature_dim(self) -> int:
-        return self.widths[-1]
+        return self.real_widths[-1]
 
     # ------------------------------------------------------------------
     # Init
@@ -101,32 +122,49 @@ class ResNet12Backbone:
             (lambda f: (cfg.num_steps, f)) if cfg.per_step_affine else (lambda f: (f,))
         )
 
-        def conv_unit(key, in_c, out_c, ksize):
+        def conv_unit(key, in_c, out_c, ksize, in_pad, out_pad):
+            # Real widths drive the RNG draw (padded and unpadded backbones
+            # from one key agree bit-for-bit on the real slice); padding
+            # lanes are structurally zero (ops/layout.py equivalence).
             return {
                 "conv": {
-                    "weight": xavier_uniform(key, (out_c, in_c, ksize, ksize), dtype),
-                    "bias": jnp.zeros((out_c,), dtype),
+                    "weight": zero_pad_to(
+                        xavier_uniform(key, (out_c, in_c, ksize, ksize), dtype),
+                        (out_pad, in_pad, ksize, ksize),
+                    ),
+                    "bias": jnp.zeros((out_pad,), dtype),
                 },
                 "norm": {
-                    "gamma": jnp.ones(affine_shape(out_c), dtype),
-                    "beta": jnp.zeros(affine_shape(out_c), dtype),
+                    "gamma": jnp.ones(affine_shape(out_pad), dtype),
+                    "beta": jnp.zeros(affine_shape(out_pad), dtype),
                 },
             }
 
         steps = cfg.num_steps if cfg.per_step_bn_statistics else None
-        for i, width in enumerate(self.widths):
+        in_pad = in_ch
+        for i, (width, width_pad) in enumerate(
+            zip(self.real_widths, self.widths)
+        ):
             stage: Params = {}
             stage_state: Params = {}
-            c = in_ch
+            c, c_pad = in_ch, in_pad
             for j in range(self.CONVS_PER_STAGE):
-                stage[f"conv{j}"] = conv_unit(next(k), c, width, 3)
-                stage_state[f"conv{j}"] = init_batch_norm_state(width, steps, dtype)
-                c = width
-            stage["shortcut"] = conv_unit(next(k), in_ch, width, 1)
-            stage_state["shortcut"] = init_batch_norm_state(width, steps, dtype)
+                stage[f"conv{j}"] = conv_unit(
+                    next(k), c, width, 3, c_pad, width_pad
+                )
+                stage_state[f"conv{j}"] = init_batch_norm_state(
+                    width_pad, steps, dtype
+                )
+                c, c_pad = width, width_pad
+            stage["shortcut"] = conv_unit(
+                next(k), in_ch, width, 1, in_pad, width_pad
+            )
+            stage_state["shortcut"] = init_batch_norm_state(
+                width_pad, steps, dtype
+            )
             params[f"res{i}"] = stage
             bn_state[f"res{i}"] = stage_state
-            in_ch = width
+            in_ch, in_pad = width, width_pad
 
         params["linear"] = {
             "weight": xavier_uniform(next(k), (cfg.num_classes, self.feature_dim), dtype),
@@ -213,8 +251,12 @@ class ResNet12Backbone:
             out = max_pool2d(out, 2, 2)
             new_bn_state[f"res{i}"] = new_state
 
-        # Global average pool over whatever spatial extent remains.
+        # Global average pool over whatever spatial extent remains; lane
+        # padding (structurally-zero channels) is sliced off before the
+        # head, so logits match the unpadded program exactly.
         out = jnp.mean(out.astype(jnp.float32), axis=(2, 3)).astype(out.dtype)
+        if out.shape[1] != self.feature_dim:
+            out = out[:, : self.feature_dim]
         logits = linear(out, params["linear"]["weight"], params["linear"]["bias"])
         return logits, new_bn_state
 

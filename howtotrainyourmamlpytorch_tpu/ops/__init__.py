@@ -11,6 +11,7 @@ from .norm import batch_norm, layer_norm, BatchNormState
 from .pool import max_pool2d, avg_pool2d
 from .losses import cross_entropy, accuracy
 from .initializers import xavier_uniform
+from .layout import lane_padded_width, zero_pad_to
 
 __all__ = [
     "conv2d",
@@ -23,4 +24,6 @@ __all__ = [
     "cross_entropy",
     "accuracy",
     "xavier_uniform",
+    "lane_padded_width",
+    "zero_pad_to",
 ]

@@ -1,0 +1,137 @@
+"""Lane-padded compute layout: zero-padded channel dims for TPU tiling.
+
+The mini-ImageNet north-star regime (PERF_NOTES.md "Mini-ImageNet
+north-star regime profile") is normalization/elementwise-traffic bound,
+and its 48-filter conv stages tile poorly against the TPU's 128-lane
+vector registers: every elementwise/norm/pool pass over a ``(..., 48)``
+channel axis wastes 5/8 of each vector register (48 against the next
+sublane-friendly width 64), and relayout traffic to compensate is exactly
+the HBM pressure the regime drowns in. The fix is a LAYOUT change, not a
+program change: pad the conv channel dims up to the nearest lane-friendly
+width with structurally-zero filters.
+
+Equivalence (the reason this is flag-safe): a zero conv filter row
+produces an all-zero output channel (its bias is zero too); per-channel
+batch norm of an all-zero channel is ``(0 - 0) * rsqrt(0 + eps) * gamma
++ beta = beta = 0``; ``leaky_relu(0) = 0``; ``max_pool(0) = 0``; and a
+zero weight COLUMN in the next conv ignores the padded input channel
+entirely, so real channels never see padding. The linear head slices the
+features back to the real channel count, so logits are the unpadded
+program's bit for bit (appending zero terms to a conv reduction leaves
+the real partial sums untouched). Gradients of every padded leaf are
+exactly zero (the head slice stops all upstream signal), so Adam moments,
+LSLR fast weights and inner-loop updates keep the padding at zero for the
+whole run — pinned by ``tests/test_layout_padding.py``.
+
+Checkpoint portability: archives NEVER contain padding. ``strip_tree``
+slices a padded state back to the unpadded template's shapes before
+``save_checkpoint`` (the PR 3 manifest is computed over the stripped
+leaves, so padded and unpadded writers produce interchangeable archives),
+and ``pad_tree`` re-embeds a restored unpadded state into a padded
+template whose padding lanes carry the canonical init values (weights 0,
+gamma 1, running_var 1, Adam moments 0).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Tree = Any
+
+#: TPU vector registers are (sublane, 128-lane) tiles; a channel axis that
+#: is a multiple of one of these widths packs them without waste. Widths
+#: at or above one full lane round up to lane multiples.
+LANE_WIDTH = 128
+SUBLANE_WIDTHS = (8, 16, 32, 64, 128)
+
+
+def lane_padded_width(channels: int, lane: int = LANE_WIDTH) -> int:
+    """Smallest lane-friendly width >= ``channels`` (48 -> 64, 64 -> 64,
+    160 -> 256). Below one full lane the next power-of-two sublane width;
+    at or above, the next multiple of ``lane``."""
+    if channels <= 0:
+        raise ValueError(f"channels must be positive, got {channels}")
+    if channels >= lane:
+        return -(-channels // lane) * lane
+    for width in SUBLANE_WIDTHS:
+        if channels <= width:
+            return width
+    return lane  # unreachable with the default tables; kept for safety
+
+
+def zero_pad_to(arr: jax.Array, target_shape: tuple[int, ...]) -> jax.Array:
+    """Zero-pads ``arr`` at the END of each axis up to ``target_shape``
+    (identity when the shapes already match)."""
+    if tuple(arr.shape) == tuple(target_shape):
+        return arr
+    if len(arr.shape) != len(target_shape) or any(
+        t < s for s, t in zip(arr.shape, target_shape)
+    ):
+        raise ValueError(
+            f"cannot zero-pad shape {tuple(arr.shape)} to {tuple(target_shape)}"
+        )
+    pads = [(0, t - s) for s, t in zip(arr.shape, target_shape)]
+    return jnp.pad(arr, pads)
+
+
+def _corner_slice(leaf: np.ndarray, shape: tuple[int, ...]) -> np.ndarray:
+    return leaf[tuple(slice(0, s) for s in shape)]
+
+
+def strip_tree(padded: Tree, unpadded_template: Tree) -> Tree:
+    """Padded state -> unpadded layout: every leaf corner-sliced to the
+    matching template leaf's shape (identity per leaf when shapes already
+    agree). Host-side — run it on gathered numpy leaves before
+    serialization. Structures must match (padding changes leaf SHAPES
+    only, never the tree)."""
+    def strip(leaf, tmpl):
+        leaf = np.asarray(leaf)
+        tshape = tuple(np.shape(tmpl))
+        if tuple(leaf.shape) == tshape:
+            return leaf
+        if len(leaf.shape) != len(tshape) or any(
+            s < t for s, t in zip(leaf.shape, tshape)
+        ):
+            raise ValueError(
+                f"cannot strip leaf of shape {leaf.shape} to {tshape}"
+            )
+        return _corner_slice(leaf, tshape)
+
+    return jax.tree.map(strip, padded, unpadded_template)
+
+
+def pad_tree(unpadded: Tree, padded_template: Tree) -> Tree:
+    """Unpadded state -> padded layout: each leaf embedded into a copy of
+    the matching ``padded_template`` leaf, whose padding lanes carry the
+    canonical init values (zero weights/biases/moments, gamma/running_var
+    ones). Host-side; the caller device-puts/shards the result."""
+    def pad(leaf, tmpl):
+        leaf = np.asarray(leaf)
+        tmpl = np.asarray(tmpl)
+        if tuple(leaf.shape) == tuple(tmpl.shape):
+            return leaf
+        if len(leaf.shape) != len(tmpl.shape) or any(
+            s > t for s, t in zip(leaf.shape, tmpl.shape)
+        ):
+            raise ValueError(
+                f"cannot pad leaf of shape {leaf.shape} into {tmpl.shape}"
+            )
+        out = tmpl.copy()
+        out[tuple(slice(0, s) for s in leaf.shape)] = leaf.astype(tmpl.dtype)
+        return out
+
+    return jax.tree.map(pad, unpadded, padded_template)
+
+
+def trees_same_shapes(a: Tree, b: Tree) -> bool:
+    """True when every corresponding leaf pair has identical shapes — the
+    "padding is a no-op at these widths" fast path (e.g. the 64-filter
+    flagship, already lane-friendly)."""
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        tuple(np.shape(x)) == tuple(np.shape(y)) for x, y in zip(la, lb)
+    )

@@ -132,7 +132,11 @@ def layer_norm(
     decided by the optimizer mask, not here.
     """
     norm_dims = tuple(range(x.ndim - weight.ndim, x.ndim))
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)  # statistics always in fp32 (bf16-safe,
+    # same contract as batch_norm — mean/var over ~C*H*W elements would
+    # otherwise accumulate in bf16 under --compute_dtype bfloat16)
     mean = jnp.mean(x, axis=norm_dims, keepdims=True)
     var = jnp.var(x, axis=norm_dims, keepdims=True)
     out = (x - mean) * jax.lax.rsqrt(var + eps)
-    return out * weight + bias
+    return (out * weight + bias).astype(orig_dtype)

@@ -228,3 +228,33 @@ def batch_sharding_spec(mesh: Mesh, leading_scan_axis: bool = False):
     ``(K, B, ...)`` and the task axis sits second."""
     spec = P(None, DEFAULT_DATA_AXIS) if leading_scan_axis else P(DEFAULT_DATA_AXIS)
     return NamedSharding(mesh, spec)
+
+
+def chunked_batch_sharding(mesh: Mesh):
+    """Layout constraint for the task-chunked scan form built INSIDE the
+    step program (``--task_chunk``: batch arrays reshaped ``(B, ...) ->
+    (n_chunks, chunk, ...)``): the sequential scan axis replicated, the
+    chunk axis — the live task axis of each scan step — over ``dp``. The
+    constraint pins GSPMD to the layout where each scan step is exactly
+    the dp-sharded program of a chunk-sized meta-batch; without it the
+    reshape of the dp-sharded task axis is free to land the partitioning
+    on the scan axis, which serializes into per-step dynamic-slice
+    gathers."""
+    return NamedSharding(mesh, P(None, DEFAULT_DATA_AXIS))
+
+
+def guard_task_chunk(mesh: Mesh | None, task_chunk: int) -> None:
+    """Refuses a ``--task_chunk`` that cannot ride the mesh's ``dp`` axis:
+    each scan step processes ``chunk`` tasks sharded over ``dp``, so the
+    chunk must be a multiple of the dp extent (otherwise some device
+    holds a ragged task share and GSPMD silently replicates the whole
+    chunk instead). No-op off-mesh or with chunking off."""
+    if mesh is None or task_chunk <= 0:
+        return
+    dp = mesh.shape.get(DEFAULT_DATA_AXIS, 1)
+    if dp > 1 and task_chunk % dp != 0:
+        raise ValueError(
+            f"--task_chunk {task_chunk} must be a multiple of the mesh's "
+            f"dp extent {dp} (each scan step shards its chunk of tasks "
+            "over 'dp')"
+        )

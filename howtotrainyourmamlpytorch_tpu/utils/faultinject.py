@@ -14,6 +14,10 @@ This module provides the failure points those tests drive:
 * ``nan_at_iter`` — poison the train batch consumed by iteration I with
   NaNs, so the meta-loss goes non-finite through the real compute path
   (float image wire only: the uint8 codec clips NaNs away);
+* ``overflow_at_iter`` — poison the same batch with near-float-max
+  magnitudes instead, so the first conv accumulation OVERFLOWS to inf in
+  the compute dtype — the bf16-path sentinel proof (bf16 shares f32's
+  exponent range, so the fault fires on either compute dtype);
 * ``sigterm_at_iter`` — deliver ``SIGTERM`` to this process right after
   iteration I's dispatch completes (TPU preemption).
 
@@ -70,6 +74,7 @@ class FaultPlan:
     truncate_checkpoint_at: int | None = None
     fail_next_writes: int = 0
     nan_at_iter: int | None = None
+    overflow_at_iter: int | None = None
     sigterm_at_iter: int | None = None
     replica_kill_at_request: int | None = None
     wedge_replica_at_request: int | None = None
@@ -174,17 +179,38 @@ def checkpoint_written(filepath: str) -> None:
 
 
 def poison_batch(sample, current_iter: int):
-    """Returns ``sample`` with NaN target images when ``current_iter`` is the
-    planned ``nan_at_iter`` (0-based index of the iteration consuming it)."""
+    """Returns ``sample`` with poisoned target images when ``current_iter``
+    is a planned batch fault (0-based index of the iteration consuming it):
+
+    * ``nan_at_iter`` — NaN targets, the divergence-sentinel classic;
+    * ``overflow_at_iter`` — near-float-max magnitudes (``3e38``), so the
+      very first conv's accumulation overflows to inf through the real
+      compute path. bf16 shares f32's exponent range, so the overflow
+      fires identically on both compute dtypes — the mixed-precision
+      sentinel test pins the bf16 one (float image wire only: the uint8
+      codec clips the injection away, same constraint as ``nan_at_iter``).
+    """
     plan = _active()
-    if plan is None or plan.nan_at_iter is None or current_iter != plan.nan_at_iter:
+    if plan is None:
         return sample
-    plan.nan_at_iter = None
-    events.append(f"nan:{current_iter}")
+    fill = None
+    if plan.nan_at_iter is not None and current_iter == plan.nan_at_iter:
+        plan.nan_at_iter = None
+        events.append(f"nan:{current_iter}")
+        fill = np.nan
+    elif (
+        plan.overflow_at_iter is not None
+        and current_iter == plan.overflow_at_iter
+    ):
+        plan.overflow_at_iter = None
+        events.append(f"overflow:{current_iter}")
+        fill = 3.0e38
+    if fill is None:
+        return sample
     # Samples are (xs, xt, ys, yt, seed) — plus a trailing on-device
     # augmentation payload when the defer-augment loader is active.
     xs, xt, *rest = sample
-    xt = np.full_like(np.asarray(xt, dtype=np.float32), np.nan)
+    xt = np.full_like(np.asarray(xt, dtype=np.float32), fill)
     return (xs, xt, *rest)
 
 

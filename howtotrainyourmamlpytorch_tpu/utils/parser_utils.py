@@ -126,8 +126,30 @@ def get_parser() -> argparse.ArgumentParser:
     add("--init_inner_loop_learning_rate", type=float, default=0.1)
     add("--weight_decay", type=float, default=0.0)
     # TPU-specific extensions (absent from the reference).
-    add("--compute_dtype", type=str, default="float32",
-        help="float32 | bfloat16 (MXU-native)")
+    add("--compute_dtype", type=str, default="auto",
+        help="auto | float32 | bfloat16. 'auto' (default) trains in "
+             "bfloat16 on TPU backends — activations/compute in bf16 with "
+             "f32 master params in the optimizer state, halving the "
+             "activation bytes that bound the north-star regime "
+             "(PERF_NOTES.md 'North-star de-bottlenecking') — and float32 "
+             "everywhere else (CPU bf16 is emulated and slower; f32 keeps "
+             "CPU receipts bit-exact). --compute_dtype float32 is the "
+             "escape hatch restoring the pre-bf16 program bit for bit")
+    add("--lane_pad_channels", type=str, default="False",
+        help="lane-padded compute layout (ops/layout.py): pad conv channel "
+             "dims up to the 128-lane-friendly width (48 -> 64) with "
+             "structurally-zero filters so norm/elementwise/pool passes "
+             "tile cleanly against the TPU's (8,128) vector registers. "
+             "Logit-bit-exact vs the unpadded program; checkpoints stay "
+             "layout-portable (padding stripped on save, re-padded on "
+             "load). No-op at already-lane-friendly widths")
+    add("--task_chunk", type=int, default=0,
+        help="task-axis memory policy: lax.scan the meta-batch in chunks "
+             "of N tasks instead of vmapping all tasks at once, bounding "
+             "live activations to chunk x per-task (the meta-batch-8 HBM "
+             "spill diagnosis knob). 0 (default) = full vmap; N must "
+             "divide the meta-batch size, and on a dp mesh must be a "
+             "multiple of the dp extent. Bit-exact within reassociation")
     add("--matmul_precision", type=str, default="default",
         choices=["default", "high", "highest", "float32"],
         help="TPU matmuls/convs on f32 inputs use bf16 multiplies under "
@@ -230,6 +252,29 @@ def extract_args_from_json(json_file_path: str, args_dict: dict) -> dict:
     return args_dict
 
 
+def resolve_compute_dtype(value) -> str:
+    """Resolves the ``--compute_dtype`` flag (including the ``auto``
+    default) to a concrete dtype name. ``auto`` means bfloat16 on TPU
+    backends — the bf16-default train path of ROADMAP item 5 — and
+    float32 everywhere else (CPU bf16 is emulated: slower, and f32 keeps
+    CPU receipts bit-exact with pre-bf16 checkpoints). Explicit values
+    pass through, so ``--compute_dtype float32`` is a hard escape hatch
+    on any backend."""
+    name = str(value or "auto").lower()
+    if name not in ("auto", "float32", "bfloat16"):
+        # Fail loud: MAMLConfig.dtype maps any non-"bfloat16" value to
+        # f32, so a typo ("bf16", "fp32") would otherwise silently train
+        # at full precision.
+        raise ValueError(
+            f"--compute_dtype must be auto | float32 | bfloat16, got {value!r}"
+        )
+    if name != "auto":
+        return name
+    import jax
+
+    return "bfloat16" if jax.default_backend() == "tpu" else "float32"
+
+
 def get_args(argv=None):
     """Returns ``(args, device)`` — args as a ``Bunch``, device the first
     JAX device."""
@@ -247,6 +292,13 @@ def get_args(argv=None):
             args_dict[key] = os.path.join(os.environ["DATASET_DIR"], args_dict[key])
 
     args = Bunch(args_dict)
+
+    # Resolve the backend-dependent compute-dtype default ONCE, here, so
+    # every consumer (config build, telemetry, logs) sees the concrete
+    # dtype rather than the "auto" sentinel.
+    args.compute_dtype = resolve_compute_dtype(
+        getattr(args, "compute_dtype", "auto")
+    )
 
     import jax
 
@@ -349,6 +401,7 @@ def args_to_maml_config(args):
         ),
         fused_norm_train=bool(getattr(args, "fused_norm_train", False)),
         fused_norm_pool=bool(getattr(args, "fused_norm_pool", False)),
+        lane_pad_channels=bool(getattr(args, "lane_pad_channels", False)),
         per_step_bn_statistics=bool(args.per_step_bn_statistics),
         num_steps=int(args.number_of_training_steps_per_iter),
         enable_inner_loop_optimizable_bn_params=bool(
@@ -400,6 +453,9 @@ def args_to_maml_config(args):
         skip_nonfinite_updates=(
             str(getattr(args, "on_nonfinite", "halt")).lower() == "skip"
         ),
-        compute_dtype=getattr(args, "compute_dtype", "float32"),
+        compute_dtype=resolve_compute_dtype(
+            getattr(args, "compute_dtype", "float32") or "float32"
+        ),
+        task_chunk=int(getattr(args, "task_chunk", 0) or 0),
         wire_codec=wire_codec_for(args),
     )

@@ -170,6 +170,29 @@ def test_device_prefetch_lints_clean_standalone():
         assert "graftlint: disable" not in f.read()
 
 
+def test_layout_module_lints_clean_standalone():
+    """The lane-padded compute layout (ISSUE 9, ``ops/layout.py``) stays
+    lint-clean as its own target with ZERO suppressions: its strip/pad
+    helpers host-numpy-interrogate leaves by design, all of it legal
+    OUTSIDE traces (checkpoint save/restore boundaries only)."""
+    layout_py = os.path.join(
+        REPO, "howtotrainyourmamlpytorch_tpu", "ops", "layout.py"
+    )
+    assert os.path.isfile(layout_py)
+    proc = run_cli(layout_py)
+    assert proc.returncode == 0, (
+        "graftlint found violations in the layout module:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "graftlint: clean" in proc.stderr
+
+    from tools.graftlint import lint_paths
+
+    assert lint_paths([layout_py]) == []
+    with open(layout_py) as f:
+        assert "graftlint: disable" not in f.read()
+
+
 def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(

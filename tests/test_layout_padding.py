@@ -1,0 +1,423 @@
+"""Lane-padded compute layout (ISSUE 9 lever 1, ``ops/layout.py``).
+
+The padding equivalence argument (zero conv filters -> zero channels ->
+per-channel BN emits beta=0 -> leaky_relu/max_pool preserve 0 -> the next
+conv's zero weight columns ignore them -> the head slices them off) must
+hold EXACTLY, not approximately, or the flag silently trains a different
+model. Pinned here:
+
+* padded vs unpadded logits BIT-EXACT across all three learners (eval);
+* second-order train parity: identical loss, real-slice parameters within
+  the documented reassociation tolerance, padding lanes FROZEN at their
+  init values over multiple meta-updates (their gradients are exactly 0);
+* compile-exactly-once under the PR 2 guard with the padded layout active;
+* a padded run on the 8-device CPU dp mesh (first-order — the GSPMD conv
+  CHECK-crash is second-order-specific, ``spmd_fo_compile_guard``);
+* checkpoint round-trip padded -> unpadded -> padded: archives NEVER
+  contain padding, so padded and unpadded writers/readers interoperate
+  bit-exactly (``CheckpointableLearner`` strips on save, re-pads on load);
+* the inference prefix load re-pads the same way (serving cold start).
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    GradientDescentLearner,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    MatchingNetsLearner,
+)
+from howtotrainyourmamlpytorch_tpu.ops.layout import (
+    lane_padded_width,
+    pad_tree,
+    strip_tree,
+    trees_same_shapes,
+    zero_pad_to,
+)
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+
+LEARNERS = [MAMLFewShotLearner, GradientDescentLearner, MatchingNetsLearner]
+
+
+def make_cfg(lane_pad=False, **kw):
+    backbone_kw = dict(
+        num_stages=2,
+        num_filters=6,  # deliberately lane-hostile: pads to 8
+        per_step_bn_statistics=True,
+        num_steps=2,
+        num_classes=5,
+        image_height=8,
+        image_width=8,
+        lane_pad_channels=lane_pad,
+    )
+    backbone_kw.update(kw.pop("backbone_kw", {}))
+    kw.setdefault("second_order", True)
+    return MAMLConfig(
+        backbone=BackboneConfig(**backbone_kw),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        **kw,
+    )
+
+
+def make_batch(rng, tasks=4, size=8):
+    xs = rng.randn(tasks, 5, 1, 1, size, size).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(np.int32)
+    return xs, xs.copy(), ys, ys.copy()
+
+
+def real_slice(padded_leaf, real_leaf):
+    return np.asarray(padded_leaf)[
+        tuple(slice(0, s) for s in np.shape(real_leaf))
+    ]
+
+
+def padding_mask(padded_leaf, real_leaf):
+    mask = np.ones(np.shape(padded_leaf), bool)
+    mask[tuple(slice(0, s) for s in np.shape(real_leaf))] = False
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# ops/layout.py units
+# ---------------------------------------------------------------------------
+
+
+def test_lane_padded_width_values():
+    # The north-star case and its neighbors: sublane powers below one full
+    # lane, lane multiples at or above it.
+    assert lane_padded_width(48) == 64
+    assert lane_padded_width(64) == 64
+    assert lane_padded_width(3) == 8
+    assert lane_padded_width(9) == 16
+    assert lane_padded_width(128) == 128
+    assert lane_padded_width(129) == 256
+    assert lane_padded_width(160) == 256  # MetaOptNet ResNet-12 stage 2
+    assert lane_padded_width(320) == 384
+    with pytest.raises(ValueError):
+        lane_padded_width(0)
+
+
+def test_zero_pad_to_identity_and_shape_errors():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    same = zero_pad_to(jax.numpy.asarray(x), (2, 3))
+    np.testing.assert_array_equal(np.asarray(same), x)
+    padded = np.asarray(zero_pad_to(jax.numpy.asarray(x), (4, 8)))
+    np.testing.assert_array_equal(padded[:2, :3], x)
+    assert np.all(padded[2:] == 0) and np.all(padded[:, 3:] == 0)
+    with pytest.raises(ValueError):
+        zero_pad_to(jax.numpy.asarray(x), (1, 3))
+    with pytest.raises(ValueError):
+        zero_pad_to(jax.numpy.asarray(x), (2, 3, 1))
+
+
+def test_strip_pad_tree_round_trip():
+    rng = np.random.RandomState(0)
+    unpadded = {"w": rng.randn(6, 3).astype(np.float32), "b": np.zeros(6, np.float32)}
+    template = {"w": np.zeros((8, 8), np.float32), "b": np.ones(8, np.float32)}
+    padded = pad_tree(unpadded, template)
+    # Padding lanes carry the template's canonical values.
+    assert np.all(padded["b"][6:] == 1.0)
+    stripped = strip_tree(padded, unpadded)
+    for k in unpadded:
+        np.testing.assert_array_equal(stripped[k], unpadded[k])
+    assert not trees_same_shapes(unpadded, template)
+    assert trees_same_shapes(padded, template)
+
+
+# ---------------------------------------------------------------------------
+# Parity across all three learners
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls", LEARNERS)
+def test_padded_eval_logits_bit_exact(cls, rng):
+    batch = make_batch(rng)
+    a = cls(make_cfg(lane_pad=False))
+    p = cls(make_cfg(lane_pad=True))
+    _, la, logits_a = a.run_validation_iter(
+        a.init_state(jax.random.PRNGKey(1)), batch
+    )
+    _, lp, logits_p = p.run_validation_iter(
+        p.init_state(jax.random.PRNGKey(1)), batch
+    )
+    np.testing.assert_array_equal(np.asarray(logits_a), np.asarray(logits_p))
+    assert float(la["loss"]) == float(lp["loss"])
+
+
+@pytest.mark.parametrize("cls", LEARNERS)
+def test_padded_train_parity_and_padding_frozen(cls, rng):
+    """Three meta-updates (second order for MAML): losses identical, the
+    real parameter slice within reassociation tolerance of the unpadded
+    program, and every padding lane still EXACTLY its init value — the
+    zero-gradient proof that padding can never leak into training."""
+    batches = [make_batch(rng) for _ in range(3)]
+    a = cls(make_cfg(lane_pad=False))
+    p = cls(make_cfg(lane_pad=True))
+    sa = a.init_state(jax.random.PRNGKey(2))
+    sp = p.init_state(jax.random.PRNGKey(2))
+    init_theta = jax.tree.map(np.asarray, sp.theta)
+    for batch in batches:
+        sa, la = a.run_train_iter(sa, batch, epoch=0)
+        sp, lp = p.run_train_iter(sp, batch, epoch=0)
+        assert float(la["loss"]) == float(lp["loss"])
+    flat_p = jax.tree_util.tree_flatten_with_path(sp.theta)[0]
+    flat_a = jax.tree_util.tree_flatten_with_path(sa.theta)[0]
+    flat_i = jax.tree_util.tree_flatten_with_path(init_theta)[0]
+    for (key, leaf_p), (_, leaf_a), (_, leaf_i) in zip(flat_p, flat_a, flat_i):
+        leaf_p = np.asarray(leaf_p)
+        np.testing.assert_allclose(
+            real_slice(leaf_p, leaf_a), np.asarray(leaf_a),
+            rtol=2e-5, atol=1e-6, err_msg=str(key),
+        )
+        mask = padding_mask(leaf_p, leaf_a)
+        np.testing.assert_array_equal(
+            leaf_p[mask], np.asarray(leaf_i)[mask], err_msg=str(key)
+        )
+
+
+def test_padded_second_order_meta_grads_match(rng):
+    """The meta-gradient itself (not just its Adam image): padded vs
+    unpadded second-order grads on the real slice within the documented
+    tolerance, exactly zero on every padding lane."""
+    import optax
+
+    cfg_a, cfg_p = make_cfg(lane_pad=False), make_cfg(lane_pad=True)
+    a, p = MAMLFewShotLearner(cfg_a), MAMLFewShotLearner(cfg_p)
+    sa = a.init_state(jax.random.PRNGKey(3))
+    sp = p.init_state(jax.random.PRNGKey(3))
+    batch = a._prepare_batch(make_batch(rng))
+    importance = a._train_importance(0)
+
+    def meta_grads(learner, state):
+        outer = {"theta": state.theta, "lslr": state.lslr}
+        return jax.grad(
+            lambda o: learner._meta_loss(
+                o, state.bn_state, batch, importance, 2, True,
+                None, True,
+            )[0]
+        )(outer)
+
+    ga, gp = meta_grads(a, sa), meta_grads(p, sp)
+    assert float(optax.global_norm(ga)) > 0  # non-degenerate comparison
+    for (key, leaf_p), (_, leaf_a) in zip(
+        jax.tree_util.tree_flatten_with_path(gp["theta"])[0],
+        jax.tree_util.tree_flatten_with_path(ga["theta"])[0],
+    ):
+        leaf_p = np.asarray(leaf_p)
+        np.testing.assert_allclose(
+            real_slice(leaf_p, leaf_a), np.asarray(leaf_a),
+            rtol=2e-5, atol=1e-6, err_msg=str(key),
+        )
+        assert np.all(leaf_p[padding_mask(leaf_p, leaf_a)] == 0.0), key
+
+
+def test_padded_resnet12_eval_bit_exact(rng):
+    cfg_kw = dict(
+        backbone_kw=dict(
+            architecture="resnet12",
+            resnet_widths=(4, 5, 6, 7),  # pads to (8, 8, 8, 8)
+            per_step_bn_statistics=False,
+            max_pooling=True,
+            # 16x16 survives the four 2x2 pools (16 -> 8 -> 4 -> 2 -> 1);
+            # 8x8 would pool a 1x1 map to empty and NaN the global mean.
+            image_height=16,
+            image_width=16,
+        ),
+        second_order=False,
+    )
+    batch = make_batch(rng, size=16)
+    a = MAMLFewShotLearner(make_cfg(lane_pad=False, **cfg_kw))
+    p = MAMLFewShotLearner(make_cfg(lane_pad=True, **cfg_kw))
+    _, la, logits_a = a.run_validation_iter(
+        a.init_state(jax.random.PRNGKey(4)), batch
+    )
+    _, lp, logits_p = p.run_validation_iter(
+        p.init_state(jax.random.PRNGKey(4)), batch
+    )
+    np.testing.assert_array_equal(np.asarray(logits_a), np.asarray(logits_p))
+    assert float(la["loss"]) == float(lp["loss"])
+
+
+def test_lane_pad_requires_conv_norm_batch_norm():
+    with pytest.raises(ValueError, match="lane_pad_channels"):
+        MAMLFewShotLearner(
+            make_cfg(
+                lane_pad=True,
+                backbone_kw=dict(norm_layer="layer_norm"),
+            )
+        ).init_state(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="lane_pad_channels"):
+        MAMLFewShotLearner(
+            make_cfg(lane_pad=True, backbone_kw=dict(block_order="norm_conv"))
+        ).init_state(jax.random.PRNGKey(0))
+
+
+def test_lane_friendly_width_is_a_no_op(rng):
+    """At an already-lane-friendly width (8) padding changes no shapes, so
+    the padded learner IS the unpadded program (and checkpoints skip the
+    strip/pad path entirely)."""
+    a = MAMLFewShotLearner(
+        make_cfg(lane_pad=False, backbone_kw=dict(num_filters=8))
+    )
+    p = MAMLFewShotLearner(
+        make_cfg(lane_pad=True, backbone_kw=dict(num_filters=8))
+    )
+    sa = a.init_state(jax.random.PRNGKey(5))
+    sp = p.init_state(jax.random.PRNGKey(5))
+    for la, lp in zip(jax.tree.leaves(sa.theta), jax.tree.leaves(sp.theta)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lp))
+    assert p._lane_pad_templates("init_state") is None
+
+
+# ---------------------------------------------------------------------------
+# Compile-once + dp mesh
+# ---------------------------------------------------------------------------
+
+
+def test_padded_train_step_compiles_once(compile_guard, rng):
+    learner = MAMLFewShotLearner(make_cfg(lane_pad=True))
+    state = learner.init_state(jax.random.PRNGKey(6))
+    with compile_guard() as guard:
+        for _ in range(3):
+            state, _ = learner.run_train_iter(state, make_batch(rng), epoch=0)
+        jax.block_until_ready(state.theta)
+    guard.assert_compiles("_train_step", exactly=1)
+    guard.assert_unique_signatures("_train_step")
+
+
+def test_padded_run_on_dp_mesh_matches_unpadded(spmd_fo_compile_guard, rng):
+    """First-order padded training on the 8-device CPU dp mesh: same
+    losses as the unpadded mesh program, padding stays frozen — the layout
+    lever composes with the PR 8 mesh scale-out."""
+    mesh = make_mesh(jax.devices()[:8], data_parallel=8, model_parallel=1)
+    kw = dict(second_order=False)
+    a = MAMLFewShotLearner(make_cfg(lane_pad=False, **kw), mesh=mesh)
+    p = MAMLFewShotLearner(make_cfg(lane_pad=True, **kw), mesh=mesh)
+    sa = a.shard_state(a.init_state(jax.random.PRNGKey(7)))
+    sp = p.shard_state(p.init_state(jax.random.PRNGKey(7)))
+    for _ in range(2):
+        batch = make_batch(rng, tasks=8)
+        sa, la = a.run_train_iter(sa, batch, epoch=0)
+        sp, lp = p.run_train_iter(sp, batch, epoch=0)
+        assert float(la["loss"]) == float(lp["loss"])
+    for leaf_p, leaf_a in zip(
+        jax.tree.leaves(p.gather_state(sp).theta),
+        jax.tree.leaves(a.gather_state(sa).theta),
+    ):
+        np.testing.assert_allclose(
+            real_slice(leaf_p, leaf_a), np.asarray(leaf_a),
+            rtol=2e-5, atol=1e-6,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint layout portability
+# ---------------------------------------------------------------------------
+
+EXP = {"current_iter": 9, "best_val_acc": 0.25}
+
+
+def test_checkpoint_round_trip_padded_unpadded_padded(tmp_path, rng):
+    """padded writer -> unpadded reader -> padded reader: the archive is
+    layout-free, every reader sees the same real-channel values, and the
+    re-padded state's padding lanes carry the canonical init values."""
+    writer = MAMLFewShotLearner(make_cfg(lane_pad=True))
+    state = writer.init_state(jax.random.PRNGKey(8))
+    state, _ = writer.run_train_iter(state, make_batch(rng), epoch=0)
+    path = os.path.join(tmp_path, "train_model_3")
+    writer.save_model(path, state, dict(EXP))
+
+    unpadded = MAMLFewShotLearner(make_cfg(lane_pad=False))
+    s_unpadded, exp = unpadded.load_model(str(tmp_path), "train_model", 3)
+    assert exp == EXP
+    for leaf_u, leaf_w in zip(
+        jax.tree.leaves(s_unpadded.theta), jax.tree.leaves(state.theta)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(leaf_u), real_slice(leaf_w, leaf_u)
+        )
+
+    # Second leg: the unpadded reader re-saves, a padded reader restores.
+    path2 = os.path.join(tmp_path, "train_model_4")
+    unpadded.save_model(path2, s_unpadded, dict(EXP))
+    padded = MAMLFewShotLearner(make_cfg(lane_pad=True))
+    s_padded, _ = padded.load_model(str(tmp_path), "train_model", 4)
+    init_padded = padded.init_state(jax.random.PRNGKey(0))
+    for leaf_p, leaf_w, leaf_i in zip(
+        jax.tree.leaves(s_padded.theta),
+        jax.tree.leaves(state.theta),
+        jax.tree.leaves(init_padded.theta),
+    ):
+        leaf_p = np.asarray(leaf_p)
+        np.testing.assert_array_equal(leaf_p.shape, np.shape(leaf_w))
+        sl = real_slice(leaf_p, real_slice(leaf_w, leaf_p))  # no-op slice
+        np.testing.assert_array_equal(sl, np.asarray(leaf_w))
+        # Padding lanes: canonical template values (zero weights, unit
+        # gammas), NOT whatever the writer's padded run carried.
+        mask = padding_mask(leaf_p, real_slice(leaf_w, leaf_p))
+        if mask.any():
+            np.testing.assert_array_equal(
+                leaf_p[mask], np.asarray(leaf_i)[mask]
+            )
+
+    # And the round-tripped padded state keeps producing identical logits.
+    batch = make_batch(rng)
+    _, _, logits_w = writer.run_validation_iter(state, batch)
+    _, _, logits_p = padded.run_validation_iter(s_padded, batch)
+    np.testing.assert_array_equal(np.asarray(logits_w), np.asarray(logits_p))
+
+
+def test_padded_archive_equals_unpadded_archive(tmp_path):
+    """Same init key, padded and unpadded writers: the serialized archives
+    hold identical leaves (manifest CRCs computed over the STRIPPED state),
+    so layout is invisible to the PR 3 integrity layer."""
+    a = MAMLFewShotLearner(make_cfg(lane_pad=False))
+    p = MAMLFewShotLearner(make_cfg(lane_pad=True))
+    pa = os.path.join(tmp_path, "train_model_1")
+    pp = os.path.join(tmp_path, "train_model_2")
+    a.save_model(pa, a.init_state(jax.random.PRNGKey(9)), dict(EXP))
+    p.save_model(pp, p.init_state(jax.random.PRNGKey(9)), dict(EXP))
+    za, zp = np.load(pa), np.load(pp)  # save_checkpoint adds no extension
+    try:
+        assert set(za.files) == set(zp.files)
+        for name in za.files:
+            np.testing.assert_array_equal(za[name], zp[name], err_msg=name)
+    finally:
+        za.close()
+        zp.close()
+
+
+def test_inference_prefix_load_re_pads(tmp_path, rng):
+    """Serving cold start: an unpadded archive restores into a padded
+    learner's inference template with the real slice intact and padding at
+    canonical init values."""
+    writer = MAMLFewShotLearner(make_cfg(lane_pad=False))
+    state = writer.init_state(jax.random.PRNGKey(10))
+    state, _ = writer.run_train_iter(state, make_batch(rng), epoch=0)
+    path = os.path.join(tmp_path, "train_model_5")
+    writer.save_model(path, state, dict(EXP))
+
+    padded = MAMLFewShotLearner(make_cfg(lane_pad=True))
+    istate, exp = padded.load_inference_state(path)
+    assert exp == EXP
+    init_istate = padded.init_inference_state(jax.random.PRNGKey(0))
+    for leaf_p, leaf_w, leaf_i in zip(
+        jax.tree.leaves(istate.theta),
+        jax.tree.leaves(state.theta),
+        jax.tree.leaves(init_istate.theta),
+    ):
+        leaf_p = np.asarray(leaf_p)
+        np.testing.assert_array_equal(real_slice(leaf_p, leaf_w), leaf_w)
+        mask = padding_mask(leaf_p, leaf_w)
+        if mask.any():
+            np.testing.assert_array_equal(
+                leaf_p[mask], np.asarray(leaf_i)[mask]
+            )

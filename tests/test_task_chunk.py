@@ -1,0 +1,246 @@
+"""Task-axis memory policy (ISSUE 9 lever 4, ``MAMLConfig.task_chunk``).
+
+``--task_chunk N`` scans the meta-batch in chunks of N tasks through the
+SAME vmapped per-task program instead of materializing every task's
+inner-loop activations at once — the HBM-spill diagnosis knob for the
+meta-batch-8 16x pathology (PERF_NOTES.md "North-star de-bottlenecking").
+The per-task math is identical; only the outer-grad accumulation order
+changes, so results must match the full vmap within reassociation
+tolerance. Pinned here:
+
+* chunked vs full-vmap SECOND-ORDER training: per-iter losses and
+  post-update parameters within reassociation tolerance;
+* a chunk that does not divide the task count is refused at trace time,
+  and a chunk that cannot ride a dp mesh is refused at construction;
+* chunking composes with the dp mesh (first-order — the GSPMD conv
+  CHECK-crash is second-order-specific, ``spmd_fo_compile_guard``);
+* ALL FOUR LEVERS together (lane_pad + bf16 + task_chunk + fused train
+  stack) on the real K=1 and K=25 train paths: compile exactly once per
+  path, zero ``jax.device_get`` in the steady state — the acceptance pin
+  that none of the levers mints signatures or host syncs.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+from howtotrainyourmamlpytorch_tpu.parallel.sharding import guard_task_chunk
+
+
+def make_cfg(**kw):
+    backbone_kw = dict(
+        num_stages=2,
+        num_filters=6,
+        per_step_bn_statistics=True,
+        num_steps=2,
+        num_classes=5,
+        image_height=8,
+        image_width=8,
+    )
+    backbone_kw.update(kw.pop("backbone_kw", {}))
+    kw.setdefault("second_order", True)
+    return MAMLConfig(
+        backbone=BackboneConfig(**backbone_kw),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        **kw,
+    )
+
+
+def make_batch(rng, tasks=4):
+    xs = rng.randn(tasks, 5, 1, 1, 8, 8).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(np.int32)
+    return xs, xs.copy(), ys, ys.copy()
+
+
+def test_task_chunk_matches_full_vmap_second_order(rng):
+    """chunk=2 over 4 tasks, second order: the scan form is the full vmap
+    within reassociation tolerance (identical per-task math, different
+    outer-grad accumulation order). The contract is pinned at the
+    META-GRADIENT level — parameter trajectories are NOT compared, because
+    Adam's eps-normalized update (``lr * m / (sqrt(v) + eps)``) amplifies
+    sub-reassociation gradient noise into O(lr) parameter jitter wherever
+    a gradient entry is near zero."""
+    import optax
+
+    full = MAMLFewShotLearner(make_cfg(task_chunk=0))
+    chunked = MAMLFewShotLearner(make_cfg(task_chunk=2))
+    sf = full.init_state(jax.random.PRNGKey(0))
+    sc = chunked.init_state(jax.random.PRNGKey(0))
+
+    def meta_grads(learner, state, batch):
+        prepared = learner._prepare_batch(batch)
+        importance = learner._train_importance(0)
+        outer = {"theta": state.theta, "lslr": state.lslr}
+        return jax.grad(
+            lambda o: learner._meta_loss(
+                o, state.bn_state, prepared, importance, 2, True, None, True
+            )[0]
+        )(outer)
+
+    grad_batch = make_batch(rng)
+    gf = meta_grads(full, sf, grad_batch)
+    gc = meta_grads(chunked, sc, grad_batch)
+    assert float(optax.global_norm(gf)) > 0  # non-degenerate comparison
+    for (key, leaf_f), (_, leaf_c) in zip(
+        jax.tree_util.tree_flatten_with_path(gf)[0],
+        jax.tree_util.tree_flatten_with_path(gc)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf_f), np.asarray(leaf_c),
+            rtol=2e-5, atol=1e-7, err_msg=str(key),
+        )
+
+    # And the real train loop: losses/metrics track per iteration.
+    for _ in range(3):
+        batch = make_batch(rng)
+        sf, lf = full.run_train_iter(sf, batch, epoch=0)
+        sc, lc = chunked.run_train_iter(sc, batch, epoch=0)
+        np.testing.assert_allclose(
+            float(lf["loss"]), float(lc["loss"]), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_task_chunk_larger_than_batch_is_full_vmap(rng):
+    """chunk >= task count degenerates to the plain vmap — bit-exact, not
+    just tolerance-close (the scan branch is never traced)."""
+    full = MAMLFewShotLearner(make_cfg(task_chunk=0))
+    big = MAMLFewShotLearner(make_cfg(task_chunk=8))
+    batch = make_batch(rng, tasks=4)
+    sf, lf = full.run_train_iter(full.init_state(jax.random.PRNGKey(1)), batch, epoch=0)
+    sb, lb = big.run_train_iter(big.init_state(jax.random.PRNGKey(1)), batch, epoch=0)
+    assert float(lf["loss"]) == float(lb["loss"])
+    for leaf_f, leaf_b in zip(
+        jax.tree.leaves(sf.theta), jax.tree.leaves(sb.theta)
+    ):
+        np.testing.assert_array_equal(np.asarray(leaf_f), np.asarray(leaf_b))
+
+
+def test_task_chunk_must_divide_task_count(rng):
+    learner = MAMLFewShotLearner(make_cfg(task_chunk=3))
+    with pytest.raises(ValueError, match="divide"):
+        learner.run_train_iter(
+            learner.init_state(jax.random.PRNGKey(2)), make_batch(rng, tasks=4),
+            epoch=0,
+        )
+
+
+def test_negative_task_chunk_refused():
+    with pytest.raises(ValueError, match="task_chunk"):
+        make_cfg(task_chunk=-1)
+
+
+def test_guard_task_chunk_requires_dp_multiple():
+    mesh = make_mesh(jax.devices()[:8], data_parallel=8, model_parallel=1)
+    with pytest.raises(ValueError, match="multiple"):
+        guard_task_chunk(mesh, 3)
+    guard_task_chunk(mesh, 8)  # fine
+    guard_task_chunk(None, 3)  # off-mesh: no constraint
+    guard_task_chunk(mesh, 0)  # chunking off: no constraint
+
+
+def test_task_chunk_on_dp_mesh_matches_full_vmap(spmd_fo_compile_guard, rng):
+    """chunk=8 over 16 tasks on the 8-device dp mesh (first order): each
+    scan step is exactly the dp-sharded program of an 8-task meta-batch,
+    and the run matches the unchunked mesh program within reassociation
+    tolerance."""
+    mesh = make_mesh(jax.devices()[:8], data_parallel=8, model_parallel=1)
+    kw = dict(second_order=False)
+    full = MAMLFewShotLearner(make_cfg(task_chunk=0, **kw), mesh=mesh)
+    chunked = MAMLFewShotLearner(make_cfg(task_chunk=8, **kw), mesh=mesh)
+    sf = full.shard_state(full.init_state(jax.random.PRNGKey(3)))
+    sc = chunked.shard_state(chunked.init_state(jax.random.PRNGKey(3)))
+    for _ in range(2):
+        batch = make_batch(rng, tasks=16)
+        sf, lf = full.run_train_iter(sf, batch, epoch=0)
+        sc, lc = chunked.run_train_iter(sc, batch, epoch=0)
+        # Loss-level parity only: parameter trajectories under Adam
+        # amplify reassociation noise (see the second-order test above).
+        np.testing.assert_allclose(
+            float(lf["loss"]), float(lc["loss"]), rtol=1e-5, atol=1e-6
+        )
+    jax.block_until_ready((sf.theta, sc.theta))
+
+
+def test_mesh_incompatible_task_chunk_refused_at_construction():
+    mesh = make_mesh(jax.devices()[:8], data_parallel=8, model_parallel=1)
+    with pytest.raises(ValueError, match="multiple"):
+        MAMLFewShotLearner(make_cfg(task_chunk=3, second_order=False), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# All four levers together: the acceptance pin
+# ---------------------------------------------------------------------------
+
+
+def all_levers_cfg():
+    return make_cfg(
+        backbone_kw=dict(fused_norm_train=True, lane_pad_channels=True),
+        compute_dtype="bfloat16",
+        task_chunk=2,
+    )
+
+
+def test_all_levers_k1_compiles_once_zero_syncs(compile_guard, rng):
+    """lane_pad + bf16 + task_chunk + fused second-order train stack on the
+    real K=1 path: one compile, unique signature, zero host syncs in the
+    steady state."""
+    learner = MAMLFewShotLearner(all_levers_cfg())
+    state = learner.init_state(jax.random.PRNGKey(4))
+    batch = make_batch(rng)
+    device_gets = {"n": 0}
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        device_gets["n"] += 1
+        return real_device_get(x)
+
+    with compile_guard() as guard:
+        state, losses = learner.run_train_iter(state, batch, epoch=0)
+        jax.device_get = counting_device_get
+        try:
+            for _ in range(3):
+                state, losses = learner.run_train_iter(state, batch, epoch=0)
+            jax.block_until_ready(state.theta)
+        finally:
+            jax.device_get = real_device_get
+    guard.assert_compiles("_train_step", exactly=1)
+    guard.assert_unique_signatures("_train_step")
+    assert device_gets["n"] == 0
+    assert np.isfinite(float(losses["loss"]))
+    # Masters stay f32 under the bf16 compute path.
+    for leaf in jax.tree.leaves(state.theta):
+        assert leaf.dtype == jax.numpy.float32
+
+
+def test_all_levers_k25_scan_compiles_once_zero_syncs(compile_guard, rng):
+    """Same composition on the real K=25 scan-dispatch path."""
+    learner = MAMLFewShotLearner(all_levers_cfg())
+    state = learner.init_state(jax.random.PRNGKey(5))
+    batches = [make_batch(rng) for _ in range(25)]
+    device_gets = {"n": 0}
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        device_gets["n"] += 1
+        return real_device_get(x)
+
+    with compile_guard() as guard:
+        state, losses = learner.run_train_iters(state, batches, epoch=0)
+        jax.device_get = counting_device_get
+        try:
+            state, losses = learner.run_train_iters(state, batches, epoch=0)
+            jax.block_until_ready(state.theta)
+        finally:
+            jax.device_get = real_device_get
+    guard.assert_compiles("multi", exactly=1)
+    guard.assert_unique_signatures("multi")
+    assert device_gets["n"] == 0
+    assert np.all(np.isfinite(np.asarray(losses["loss"])))

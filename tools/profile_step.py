@@ -5,6 +5,8 @@ an optional jax.profiler trace capture.
 Usage:
   python tools/profile_step.py [--config flagship|imagenet]
                                [--batch N] [--compute-dtype bfloat16]
+                               [--lane-pad] [--task-chunk N]
+                               [--fused-train] [--fused-pool]
                                [--conv-layout NHWC] [--k K]
                                [--trace profiles/flagship]
 
@@ -69,6 +71,15 @@ def main() -> None:
                         help="also fuse the 2x2 max-pool epilogue into the "
                              "norm kernel on even-sized stages "
                              "(fused_norm_pool; implies a fused variant)")
+    parser.add_argument("--lane-pad", action="store_true",
+                        help="lane-padded compute layout (lane_pad_channels; "
+                             "ops/layout.py): conv channels padded to the "
+                             "128-lane-friendly width, 48 -> 64 at the "
+                             "imagenet shapes")
+    parser.add_argument("--task-chunk", type=int, default=0,
+                        help="scan the meta-batch in task chunks of N "
+                             "instead of one vmap (task_chunk; bounds live "
+                             "activations — the HBM-spill lever). 0 = off")
     args = parser.parse_args()
 
     import dataclasses
@@ -105,6 +116,13 @@ def main() -> None:
                 fused_norm_pool=args.fused_pool,
             ),
         )
+    if args.lane_pad:
+        cfg = dataclasses.replace(
+            cfg,
+            backbone=dataclasses.replace(cfg.backbone, lane_pad_channels=True),
+        )
+    if args.task_chunk:
+        cfg = dataclasses.replace(cfg, task_chunk=args.task_chunk)
     if args.conv_layout:
         from howtotrainyourmamlpytorch_tpu.ops import conv as conv_ops
 
@@ -133,6 +151,30 @@ def main() -> None:
     bytes_iter = float(cost.get("bytes accessed", 0.0))
     print(f"flops/iter          : {flops_iter:.3e}")
     print(f"hbm bytes/iter      : {bytes_iter:.3e} (fusion-overcounted upper bound)")
+    # Bytes-accessed split (operand reads vs output writes) straight from
+    # cost_analysis, so traffic-bound claims — and what each lever
+    # (--lane-pad / --compute-dtype / --task-chunk) does to them — are
+    # attributable without a profiler trace. Keys are backend-dependent;
+    # absent keys print as n/a rather than zero.
+    operand_bytes = sum(
+        float(v) for k, v in cost.items()
+        if isinstance(k, str) and k.startswith("bytes accessed operand")
+    )
+    output_bytes = sum(
+        float(v) for k, v in cost.items()
+        if isinstance(k, str) and k.startswith("bytes accessed output")
+    )
+    if operand_bytes or output_bytes:
+        print(f"  operand reads     : {operand_bytes:.3e} "
+              f"({100 * operand_bytes / max(bytes_iter, 1.0):.0f}%)")
+        print(f"  output writes     : {output_bytes:.3e} "
+              f"({100 * output_bytes / max(bytes_iter, 1.0):.0f}%)")
+        if flops_iter:
+            print(f"  arithmetic int.   : {flops_iter / max(bytes_iter, 1.0):.2f} "
+                  "flops/byte (v5e needs ~240 to feed the MXU from HBM)")
+    else:
+        print("  operand/output split: n/a (backend cost model omits "
+              "per-operand byte counts)")
 
     # Wire bytes per iter (uint8 images + int32 labels).
     xs, xt, ys, yt = learner._prepare_batch(batches[0])
