@@ -37,7 +37,19 @@ Design contracts:
   sizing from the telemetry ``data_wait`` split.
 * **Deterministic faults.** ``utils.faultinject.poison_batch`` runs on the
   host sample inside the stager (a None-check no-op when inactive), so
-  ``nan_at_iter`` keeps poisoning the exact planned iteration.
+  ``nan_at_iter`` keeps poisoning the exact planned iteration;
+  ``producer_fail_at_iter`` injects a transient loader error at the exact
+  planned pull.
+* **Fault quarantine.** A transient producer exception (loader I/O blip,
+  one corrupt episode) no longer kills training at the consumer's next
+  pop: with ``fault_budget > 0`` the stager emits a ``data_fault``
+  telemetry event, SKIPS the failed batch window, and carries on — the
+  train loop sees one fewer batch and the outer epoch loop re-enters with
+  a fresh generator for the remainder. A fault past the budget (or a
+  non-``Exception`` error) fails fast: the original exception propagates
+  to the consumer chained under :class:`DataPipelineError` with its
+  producer-side traceback intact, after a final ``data_fault`` event with
+  ``fatal=True``.
 * **Mesh-aware.** With ``sharding`` set (the learner's declared batch
   ``in_shardings`` — ``staged_batch_sharding``), the put is sharding-aware:
   staged arrays land already laid out across the mesh, so dp-sharded
@@ -59,7 +71,15 @@ import jax
 import numpy as np
 
 from ..models.common import StagedBatch
+from ..telemetry import events as telemetry_events
 from ..utils import faultinject
+
+
+class DataPipelineError(RuntimeError):
+    """The device-prefetch producer died (or exhausted its quarantine
+    budget). The original producer exception is chained as ``__cause__``
+    with its stager-thread traceback intact — the consumer-side raise no
+    longer loses where the pipeline actually failed."""
 
 #: ``depth`` sentinel: start at DEFAULT_DEPTH, grow to MAX_AUTO_DEPTH when
 #: the consumer's measured stage-wait says staging cannot keep up.
@@ -103,6 +123,7 @@ class DevicePrefetcher:
         start_iter: int = 0,
         epoch_len: int | None = None,
         sharding=None,
+        fault_budget: int = 0,
     ):
         if group < 1:
             raise ValueError(f"group must be >= 1, got {group}")
@@ -124,6 +145,11 @@ class DevicePrefetcher:
         self._group = int(group)
         self._epoch_len = int(epoch_len) if epoch_len else None
         self._next_iter = int(start_iter)
+        # Quarantine budget: transient producer faults tolerated (skipping
+        # the failed batch window each time) before the stager fails fast.
+        # 0 = the strict pre-quarantine behavior — first fault is fatal.
+        self._fault_budget = int(fault_budget)
+        self.faults_quarantined = 0
 
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -151,6 +177,7 @@ class DevicePrefetcher:
         first_iter) — samples may be shorter than ``group`` at the end of
         the stream, and empty at exhaustion."""
         first = self._next_iter
+        faultinject.producer_pull(first)
         want = self._group
         if self._epoch_len:
             remaining = self._epoch_len - first % self._epoch_len
@@ -193,6 +220,29 @@ class DevicePrefetcher:
             first_iter=first_iter,
         )
 
+    def _quarantine(self, exc: BaseException, first_iter: int) -> bool:
+        """One producer fault: emits a ``data_fault`` telemetry event and
+        decides retry-and-skip (True — within budget, the failed batch
+        window is skipped and the stream continues with the next pull) vs
+        fail fast (False — budget exhausted, or a non-``Exception`` error
+        like ``GeneratorExit``/``KeyboardInterrupt`` that no skip policy
+        should swallow)."""
+        fatal = (
+            not isinstance(exc, Exception)
+            or self.faults_quarantined >= self._fault_budget
+        )
+        if not fatal:
+            self.faults_quarantined += 1
+        telemetry_events.emit(
+            "data_fault",
+            iter=int(first_iter),
+            error=f"{type(exc).__name__}: {exc}"[:300],
+            quarantined=self.faults_quarantined,
+            budget=self._fault_budget,
+            fatal=fatal,
+        )
+        return not fatal
+
     def _produce(self) -> None:
         try:
             while True:
@@ -204,10 +254,24 @@ class DevicePrefetcher:
                         self._not_full.wait()
                     if self._closed:
                         return
-                samples, first = self._pull_group()
-                if not samples:
-                    break
-                staged = self._stage(samples, first)
+                planned_first = self._next_iter
+                try:
+                    samples, first = self._pull_group()
+                    if not samples:
+                        break
+                    staged = self._stage(samples, first)
+                except BaseException as exc:  # noqa: BLE001 — quarantine gate
+                    if not self._quarantine(exc, planned_first):
+                        raise
+                    # Skipped batch window: re-plan the SAME iteration
+                    # numbers onto the next pull (fresh episodes), so the
+                    # planned numbering stays contiguous — epoch-boundary
+                    # grouping and fault-plan targeting are unaffected; the
+                    # train loop just receives one fewer batch and the
+                    # outer epoch loop re-enters with a fresh generator for
+                    # the remainder.
+                    self._next_iter = planned_first
+                    continue
                 with self._lock:
                     if self._closed:
                         self._release(staged)
@@ -244,7 +308,16 @@ class DevicePrefetcher:
                 return staged
             if self._error is not None:
                 error, self._error = self._error, None
-                raise error
+                # The producer died in the stager thread; surface it HERE
+                # (the consumer's pop) as a typed pipeline error with the
+                # ORIGINAL exception — and its producer-side traceback —
+                # chained, instead of an opaque re-raise that reads as if
+                # the consumer itself failed.
+                raise DataPipelineError(
+                    "device-prefetch producer died: "
+                    f"{type(error).__name__}: {error} (producer traceback "
+                    "chained below)"
+                ) from error
             raise StopIteration
 
     def _maybe_deepen(self, waited: float) -> None:

@@ -25,6 +25,7 @@ builder (``self.train_state``) and threaded through ``run_train_iter`` /
 
 from __future__ import annotations
 
+import contextlib
 import os
 import signal
 import sys
@@ -38,7 +39,12 @@ from .data.device_prefetch import AUTO_DEPTH, DevicePrefetcher
 from .models.common import StagedBatch, prepare_batch
 from .telemetry import TrainTelemetry
 from .utils import faultinject
-from .utils.checkpoint import CheckpointCorruptError, publish_alias
+from .utils.checkpoint import (
+    AsyncCheckpointWriter,
+    CheckpointCorruptError,
+    publish_alias,
+)
+from .utils.watchdog import HANG_EXIT_CODE, DispatchWatchdog
 from .utils.storage import (
     build_experiment_folder,
     save_statistics,
@@ -226,6 +232,38 @@ class ExperimentBuilder:
                 getattr(args, "profile_trigger_path", "") or ""
             ),
         )
+        # Training-side resilience layer (the serve-path design of PR 6
+        # mirrored onto the train path):
+        # * dispatch hang watchdog (utils/watchdog.py): armed around every
+        #   device dispatch, deadline from the observed step-time
+        #   distribution; on expiry -> thread-stack diagnostic + the
+        #   DISTINCT requeue-degraded exit code (HANG_EXIT_CODE, not 75 —
+        #   the dispatcher must tell "preempted, resume same mesh" from
+        #   "hung, suspect the topology").
+        # * async background checkpointing: save = critical-path snapshot
+        #   (one batched device_get) + background serialize/CRC/rename on
+        #   a single writer thread, drained (fenced) on EVERY exit path.
+        # * a time-based checkpoint cadence bounding RPO on long epochs.
+        # Both are created lazily in run_experiment (and closed in its
+        # finally) so builders constructed for inspection never leak
+        # threads.
+        def knob(name, default):
+            # None (flag absent from an older config) -> default; an
+            # EXPLICIT 0 is honored, not silently replaced (a 0 factor
+            # pins the watchdog deadline at the floor; min_s=0 is the
+            # ctor's explicit ValueError).
+            value = getattr(args, name, None)
+            return default if value is None else value
+
+        self.watchdog_enabled = bool(knob("watchdog", True))
+        self.watchdog_min_s = float(knob("watchdog_min_s", 600.0))
+        self.watchdog_factor = float(knob("watchdog_factor", 20.0))
+        self.checkpoint_async = bool(knob("checkpoint_async", True))
+        self.checkpoint_interval_s = float(knob("checkpoint_interval_s", 0.0))
+        self.data_fault_budget = int(knob("data_fault_budget", 8))
+        self._watchdog: DispatchWatchdog | None = None
+        self._ckpt_writer: AsyncCheckpointWriter | None = None
+        self._last_ckpt_t = time.monotonic()
 
     # ------------------------------------------------------------------
     # Metric summarization (experiment_builder.py:65-100)
@@ -375,7 +413,12 @@ class ExperimentBuilder:
             flush=True,
         )
 
-    def _write_interruption_row(self) -> None:
+    def _write_interruption_row(self, kind=None) -> None:
+        """Audit row in ``logs/interruptions.csv``. ``kind`` defaults to
+        the pending shutdown signal number; the watchdog passes ``"hang"``
+        (and the dispatcher appends its own degrade/promote rows to the
+        same file), so the full interruption history of an experiment
+        reads from one place."""
         interruptions = os.path.join(self.logs_filepath, "interruptions.csv")
         if not os.path.exists(interruptions):
             save_statistics(
@@ -386,10 +429,44 @@ class ExperimentBuilder:
             )
         save_statistics(
             self.logs_filepath,
-            [time.time(), int(self._shutdown_signum),
+            [time.time(),
+             int(self._shutdown_signum) if kind is None else kind,
              int(self.state["current_iter"]), self.epoch],
             filename="interruptions.csv",
         )
+
+    # ------------------------------------------------------------------
+    # Dispatch hang watchdog (utils/watchdog.py)
+    # ------------------------------------------------------------------
+
+    def _armed(self, upto_iter: int):
+        """Watchdog-armed window for one device dispatch (no-op context
+        when the watchdog is disabled or not yet running)."""
+        if self._watchdog is None:
+            return contextlib.nullcontext()
+        return self._watchdog.armed(upto_iter)
+
+    def _on_hang(self, diag: dict) -> None:
+        """Bounded graceful unwind, called from the watchdog's monitor
+        thread right before it exits the process with ``HANG_EXIT_CODE``:
+        fence the async checkpoint writer (a COMPLETED in-flight epoch
+        write is worth the bounded wait; an incomplete one tears a
+        harmless ``.tmp``), append the audit row, and flush telemetry —
+        the ``hang`` event with the thread stacks is already buffered.
+        The wedged device dispatch itself is never touched: it cannot be
+        safely interrupted, which is exactly why the unwind ends in
+        ``os._exit``."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain(raise_errors=False, timeout=30.0)
+        try:
+            self._write_interruption_row(kind="hang")
+        except OSError:
+            pass  # diagnostics must not block the exit
+        self.telemetry.event(
+            "requeue_exit", code=HANG_EXIT_CODE, hang=True,
+            iter=int(diag.get("iter", -1)),
+        )
+        self.telemetry.shutdown()
 
     def _pending_nonfinite_trips(self) -> float:
         """Sentinel trips in the epoch-so-far accumulated metrics (forces
@@ -422,6 +499,15 @@ class ExperimentBuilder:
             "preemption", signal=int(self._shutdown_signum),
             iter=int(self.state["current_iter"]),
         )
+        # FENCE: an in-flight async checkpoint write must fully publish
+        # (epoch file + latest alias) before the emergency ``latest``
+        # write below can run — otherwise the background alias publish
+        # could clobber the newer emergency state, or the emergency write
+        # could race the epoch serialize. Writer errors are NOT raised
+        # here: the emergency write is the last line of defense and must
+        # still be attempted.
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain(raise_errors=False)
         if not write_checkpoint:
             self._write_interruption_row()
             print(
@@ -547,6 +633,11 @@ class ExperimentBuilder:
             trip_iter, trips = signal_or_iter.trip_iter, signal_or_iter.trips
         else:
             trip_iter, trips = int(signal_or_iter), 1.0
+        # FENCE: let any in-flight async epoch write publish before the
+        # reload scans for the newest valid checkpoint (the in-flight one
+        # may BE the newest valid state; its submit preceded the trip).
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain()
         self._rollbacks_this_run += 1
         if self._rollbacks_this_run > MAX_ROLLBACKS_PER_RUN:
             raise NonFiniteLossError(
@@ -641,33 +732,42 @@ class ExperimentBuilder:
         if sample_idx == 0:
             print("shape of data", *shapes)
 
-        self.train_state, losses = self.model.run_train_iter(
-            self.train_state, data_batch, epoch=epoch_idx
-        )
-        self._record_dispatch(upto_iter=current_iter + 1)
-        # Metrics are device scalars; they are appended UNREAD so the host
-        # never blocks on the step it just dispatched (the summary forces
-        # them at epoch boundaries). Reading per-iteration here measured an
-        # ~8x train-throughput loss through the device tunnel.
-        for key, value in losses.items():
-            total_losses.setdefault(key, []).append(value)
-
-        current_iter += 1
-        if current_iter % TRAIN_LOG_EVERY == 0 or current_iter == 1:
-            # Both the print and the sentinel force the same already-computed
-            # device scalars — one sync, shared. The forced read is timed as
-            # the host-sync share of the step breakdown, and the telemetry
-            # buffer flushes HERE (its only hot-loop I/O point).
-            t_sync = time.perf_counter()
-            self._sentinel_check(losses, current_iter)
-            summary = self.build_loss_summary_string(losses)
-            sync_s = time.perf_counter() - t_sync
-            print(
-                f"training iter {current_iter} epoch {self.epoch} -> "
-                + summary,
-                flush=True,
+        # The watchdog-armed window covers the dispatch AND the
+        # log-cadence forced read below — the two places a wedged device
+        # runtime parks this thread forever. The deterministic hang fault
+        # stalls HERE, inside the armed window, exactly like a stuck
+        # collective.
+        with self._armed(current_iter + 1):
+            faultinject.hang_due(current_iter)
+            self.train_state, losses = self.model.run_train_iter(
+                self.train_state, data_batch, epoch=epoch_idx
             )
-            self.telemetry.boundary(current_iter, sync_s, reason="log")
+            self._record_dispatch(upto_iter=current_iter + 1)
+            # Metrics are device scalars; they are appended UNREAD so the
+            # host never blocks on the step it just dispatched (the summary
+            # forces them at epoch boundaries). Reading per-iteration here
+            # measured an ~8x train-throughput loss through the device
+            # tunnel.
+            for key, value in losses.items():
+                total_losses.setdefault(key, []).append(value)
+
+            current_iter += 1
+            if current_iter % TRAIN_LOG_EVERY == 0 or current_iter == 1:
+                # Both the print and the sentinel force the same
+                # already-computed device scalars — one sync, shared. The
+                # forced read is timed as the host-sync share of the step
+                # breakdown, and the telemetry buffer flushes HERE (its
+                # only hot-loop I/O point).
+                t_sync = time.perf_counter()
+                self._sentinel_check(losses, current_iter)
+                summary = self.build_loss_summary_string(losses)
+                sync_s = time.perf_counter() - t_sync
+                print(
+                    f"training iter {current_iter} epoch {self.epoch} -> "
+                    + summary,
+                    flush=True,
+                )
+                self.telemetry.boundary(current_iter, sync_s, reason="log")
         return total_losses, current_iter
 
     def train_iteration_multi(self, samples, epoch_idx, total_losses, current_iter):
@@ -681,24 +781,28 @@ class ExperimentBuilder:
         else:
             n_iters = len(samples)
             batches = [tuple(s[:4]) + tuple(s[5:]) for s in samples]
-        self.train_state, losses = self.model.run_train_iters(
-            self.train_state, batches, epoch=epoch_idx
-        )
-        self._record_dispatch(n_iters, upto_iter=current_iter + n_iters)
-        for key, value in losses.items():
-            total_losses.setdefault(key, []).append(value)
-        current_iter += n_iters
-        if _multi_log_due(current_iter, n_iters):
-            t_sync = time.perf_counter()
-            self._sentinel_check(losses, current_iter)
-            summary = self.build_loss_summary_string(losses)
-            sync_s = time.perf_counter() - t_sync
-            print(
-                f"training iter {current_iter} epoch {self.epoch} -> "
-                + summary,
-                flush=True,
+        # Armed around the K-scan dispatch + its forced read, like the K=1
+        # path; the hang fault stalls at the group's first iteration.
+        with self._armed(current_iter + n_iters):
+            faultinject.hang_due(current_iter)
+            self.train_state, losses = self.model.run_train_iters(
+                self.train_state, batches, epoch=epoch_idx
             )
-            self.telemetry.boundary(current_iter, sync_s, reason="log")
+            self._record_dispatch(n_iters, upto_iter=current_iter + n_iters)
+            for key, value in losses.items():
+                total_losses.setdefault(key, []).append(value)
+            current_iter += n_iters
+            if _multi_log_due(current_iter, n_iters):
+                t_sync = time.perf_counter()
+                self._sentinel_check(losses, current_iter)
+                summary = self.build_loss_summary_string(losses)
+                sync_s = time.perf_counter() - t_sync
+                print(
+                    f"training iter {current_iter} epoch {self.epoch} -> "
+                    + summary,
+                    flush=True,
+                )
+                self.telemetry.boundary(current_iter, sync_s, reason="log")
         return total_losses, current_iter
 
     def evaluation_iteration(self, val_sample, total_losses, phase):
@@ -732,9 +836,31 @@ class ExperimentBuilder:
         # (device_get + npz) and ``latest`` is published as a
         # hardlink-or-copy alias of it — previously the identical state was
         # serialized twice (PERF_NOTES.md "Checkpoint write cost").
+        #
+        # Async mode (--checkpoint_async, default): the critical path pays
+        # only the snapshot (gather + ONE batched device_get — required
+        # for correctness, the state must be captured before training
+        # mutates it); manifest/CRC/serialize/rename and the alias publish
+        # run on the background writer thread, in order. The PR 3
+        # retry/quarantine contract is untouched (write_snapshot is the
+        # same retrying writer), and a writer failure surfaces at the next
+        # submit/drain boundary with the same typed error.
         epoch_path = self._checkpoint_path(int(epoch))
-        model.save_model(epoch_path, self.train_state, state)
-        publish_alias(epoch_path, self._checkpoint_path("latest"))
+        latest = self._checkpoint_path("latest")
+        t0 = time.perf_counter()
+        if self._ckpt_writer is not None and hasattr(model, "snapshot_model"):
+            snapshot = model.snapshot_model(self.train_state, state)
+            self._ckpt_writer.submit(epoch_path, snapshot, alias_dst=latest)
+            self.telemetry.event(
+                "checkpoint_submit",
+                path=os.path.basename(epoch_path),
+                stall_s=time.perf_counter() - t0,
+                pending=self._ckpt_writer.pending,
+            )
+        else:
+            model.save_model(epoch_path, self.train_state, state)
+            publish_alias(epoch_path, latest)
+        self._last_ckpt_t = time.monotonic()
         print("saved models to", self.saved_models_filepath)
 
     def pack_and_save_metrics(self, start_time, create_summary_csv, train_losses,
@@ -843,6 +969,15 @@ class ExperimentBuilder:
 
     def run_experiment(self):
         self._install_signal_handlers()
+        if self.checkpoint_async and self._ckpt_writer is None:
+            self._ckpt_writer = AsyncCheckpointWriter()
+        if self.watchdog_enabled and self._watchdog is None:
+            self._watchdog = DispatchWatchdog(
+                min_deadline_s=self.watchdog_min_s,
+                factor=self.watchdog_factor,
+                logs_dir=self.logs_filepath,
+                on_hang=self._on_hang,
+            )
         try:
             # activate(): installs the process-global event sink (so
             # checkpoint saves/loads and serve dispatches self-report), the
@@ -853,8 +988,29 @@ class ExperimentBuilder:
             with self.telemetry.activate():
                 return self._run_experiment()
         finally:
+            if self._watchdog is not None:
+                self._watchdog.close()
+                self._watchdog = None
+            writer_error = None
+            if self._ckpt_writer is not None:
+                # Drain-and-close on EVERY exit path (clean pause exits
+                # via sys.exit, crashes unwind through here): no async
+                # write may outlive the process's telemetry/exit
+                # bookkeeping. A writer failure on an otherwise-clean
+                # exit re-raises below — the sync path would have raised
+                # at the same epoch boundary.
+                self._ckpt_writer.drain(raise_errors=False)
+                writer_error = self._ckpt_writer.pending_error()
+                self._ckpt_writer.close()
+                self._ckpt_writer = None
             self.telemetry.shutdown()
             self._restore_signal_handlers()
+            in_flight = sys.exc_info()[1]
+            benign_exit = in_flight is None or (
+                isinstance(in_flight, SystemExit) and not in_flight.code
+            )
+            if writer_error is not None and benign_exit:
+                raise writer_error
 
     def _run_experiment(self):
         total_iters = int(self.args.total_epochs * self.args.total_iter_per_epoch)
@@ -866,6 +1022,12 @@ class ExperimentBuilder:
                 self._train_until_rollback(total_iters)
             except _RollbackSignal as trip:
                 self._perform_rollback(trip)
+        # FENCE before the ensemble phase: the final epoch's async write
+        # must be on disk before the ensemble loads epoch checkpoints (and
+        # a failed write must fail the run here, not silently ensemble
+        # without its epoch).
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain()
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
 
     def _make_stager(self, batches) -> "DevicePrefetcher | None":
@@ -906,6 +1068,11 @@ class ExperimentBuilder:
             start_iter=int(self.state["current_iter"]),
             epoch_len=int(self.args.total_iter_per_epoch),
             sharding=sharding,
+            # Transient producer faults (loader I/O blip, one corrupt
+            # episode) are retried-then-skipped under this budget instead
+            # of killing training at the next pop (--data_fault_budget;
+            # 0 restores fail-fast).
+            fault_budget=self.data_fault_budget,
         )
 
     def _train_until_rollback(self, total_iters):
@@ -1000,14 +1167,60 @@ class ExperimentBuilder:
     def _post_dispatch_boundary(self) -> None:
         """Everything that runs after a completed dispatch: the epoch
         boundary (summary, validation, checkpoint, pause) when the
-        iteration count crossed one, then the preemption check — AFTER the
-        epoch block, so a signal landing on a boundary dispatch still gets
-        its val epoch + epoch checkpoint + stats row before the exit (a
-        mid-epoch emergency resume cannot reconstruct those)."""
+        iteration count crossed one — else the time-based checkpoint
+        cadence — then the preemption check — AFTER the epoch block, so a
+        signal landing on a boundary dispatch still gets its val epoch +
+        epoch checkpoint + stats row before the exit (a mid-epoch
+        emergency resume cannot reconstruct those)."""
         if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
             self._run_epoch_boundary()
+        elif (
+            self.checkpoint_interval_s > 0
+            and time.monotonic() - self._last_ckpt_t
+            >= self.checkpoint_interval_s
+        ):
+            self._interval_checkpoint()
         faultinject.sigterm_due(self.state["current_iter"])
         self._maybe_emergency_exit()
+
+    def _interval_checkpoint(self) -> None:
+        """Time-based mid-epoch checkpoint (``--checkpoint_interval_s``):
+        bounds the recovery point age on long epochs — a preemption, crash
+        or hang loses at most the cadence, not the whole epoch. Writes the
+        full resume-compatible state directly to ``train_model_latest``
+        (exactly the emergency-write form, so resume needs nothing new).
+        The sentinel contract holds: pending non-finite trips are forced
+        here (this cadence is its own documented read boundary, off by
+        default) and a poisoned state is never persisted — the log-cadence
+        sentinel escalates it instead."""
+        trips = (
+            self._pending_nonfinite_trips() if self.on_nonfinite != "skip"
+            else 0.0
+        )
+        if trips:
+            print(
+                "WARNING: non-finite meta-loss pending at the checkpoint "
+                "interval; skipping the interval write (the sentinel "
+                "policy handles the poisoned state)",
+                file=sys.stderr,
+            )
+            self._last_ckpt_t = time.monotonic()
+            return
+        path = self._checkpoint_path("latest")
+        t0 = time.perf_counter()
+        if self._ckpt_writer is not None and hasattr(
+            self.model, "snapshot_model"
+        ):
+            snapshot = self.model.snapshot_model(self.train_state, self.state)
+            self._ckpt_writer.submit(path, snapshot)
+        else:
+            self.model.save_model(path, self.train_state, self.state)
+        self._last_ckpt_t = time.monotonic()
+        self.telemetry.event(
+            "checkpoint_interval",
+            iter=int(self.state["current_iter"]),
+            stall_s=time.perf_counter() - t0,
+        )
 
     def _run_epoch_boundary(self) -> None:
         # The epoch summary is the big forced read of the loop

@@ -550,6 +550,24 @@ class CheckpointableLearner:
             state = strip_tree(state, templates[0])
         save_checkpoint(model_save_dir, state, experiment_state)
 
+    def snapshot_model(self, state, experiment_state: dict):
+        """The critical-path half of ``save_model`` for async
+        checkpointing: gather + lane-pad strip + ONE batched ``device_get``
+        into a host :class:`~..utils.checkpoint.CheckpointSnapshot`.
+        ``write_snapshot`` (on the background writer thread) then produces
+        an archive byte-compatible with ``save_model``'s — same manifest,
+        same layout-portability (padding is stripped HERE, before the
+        snapshot)."""
+        from ..utils.checkpoint import snapshot_for_save
+
+        state = self.gather_state(state)
+        templates = self._lane_pad_templates("init_state")
+        if templates is not None:
+            from ..ops.layout import strip_tree
+
+            state = strip_tree(state, templates[0])
+        return snapshot_for_save(state, experiment_state)
+
     def load_model(self, model_save_dir: str, model_name: str, model_idx):
         import os
 

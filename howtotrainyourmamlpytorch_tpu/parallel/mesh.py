@@ -84,6 +84,27 @@ def default_mesh_from_args(args) -> Mesh | None:
     return make_mesh(devices[: n * mp], data_parallel=n, model_parallel=mp)
 
 
+def degraded_dp_extent(
+    dp: int, *, global_batch: int, task_chunk: int = 0
+) -> int | None:
+    """Next-smaller viable dp extent after a suspect-topology failure
+    (watchdog hang / device-attributed crash): half-steps 8 -> 4 -> 2 -> 1,
+    skipping extents the run's own constraints refuse — the global
+    meta-batch must divide over ``dp`` (``default_mesh_from_args``) and an
+    active ``--task_chunk`` must be a multiple of it
+    (``sharding.guard_task_chunk``). Returns ``None`` when no smaller
+    viable extent exists (dp is already 1, or nothing divides) — the
+    dispatcher then requeues on the same topology and lets the hang budget
+    decide. Pure host math: safe for the dispatcher to call without
+    touching the (possibly wedged) backend."""
+    n = int(dp) // 2
+    while n >= 1:
+        if global_batch % n == 0 and (task_chunk <= 0 or task_chunk % n == 0):
+            return n
+        n //= 2
+    return None
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -26,7 +26,17 @@ resume, ``README.md:91-93`` — this layer makes that mechanical):
 * structural mismatches (a checkpoint from a different config/architecture)
   fail fast with ``ValueError`` — never a silent load-by-truncation;
 * the ``latest`` pointer is published as a hardlink-or-copy alias of the
-  epoch file (``publish_alias``) — one serialization per epoch, not two.
+  epoch file (``publish_alias``) — one serialization per epoch, not two;
+* the write splits into a critical-path half (``snapshot_for_save``: one
+  batched ``device_get`` — required for correctness, the state must be
+  captured before training mutates it) and a background-safe half
+  (``write_snapshot``: CRC + serialize + atomic rename + retry), so
+  :class:`AsyncCheckpointWriter` can run everything but the snapshot on a
+  single writer thread off the train loop's critical path. The writer is
+  DRAINED on every exit path (epoch pause, SIGTERM emergency write,
+  rollback, crash) — an in-flight async write can never interleave with
+  the emergency ``latest`` write, and a writer failure surfaces with the
+  same typed errors the synchronous path raises.
 """
 
 from __future__ import annotations
@@ -34,6 +44,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
 import zlib
 from typing import Any
@@ -110,31 +121,53 @@ def _leaf_crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
-def save_checkpoint(
-    filepath: str,
-    state_tree: Tree,
-    experiment_state: dict,
-    *,
-    retries: int = WRITE_RETRIES,
-    backoff_s: float = WRITE_BACKOFF_S,
-) -> str:
-    """Writes leaves + experiment state + integrity manifest to ``filepath``
-    (no extension added), atomically, retrying transient ``OSError`` up to
-    ``retries`` total attempts with exponential backoff.
+class CheckpointSnapshot:
+    """Host-materialized capture of a train state: everything the writer
+    needs, nothing device-resident — safe to hand to a background thread
+    while training mutates (or donates) the live state buffers."""
 
-    Device arrays are fetched with ONE batched ``jax.device_get`` — per-leaf
-    ``np.asarray`` costs a full device round trip each (~10 s per save
-    through the axon tunnel vs ~0.2 s batched)."""
-    t_start = time.perf_counter()
-    host_leaves, treedef = jax.tree.flatten(state_tree)
+    __slots__ = ("arrays", "exp_bytes", "tree_crc32")
+
+    def __init__(self, arrays: dict, exp_bytes: bytes, tree_crc32: int):
+        self.arrays = arrays
+        self.exp_bytes = exp_bytes
+        self.tree_crc32 = tree_crc32
+
+
+def snapshot_for_save(state_tree: Tree, experiment_state: dict) -> CheckpointSnapshot:
+    """The critical-path half of a checkpoint write: flatten + ONE batched
+    ``jax.device_get`` (per-leaf ``np.asarray`` costs a full device round
+    trip each — ~10 s per save through the axon tunnel vs ~0.2 s batched)
+    + the JSON experiment-state encode. CRC/serialize/rename live in
+    ``write_snapshot`` and can run on a background writer thread."""
+    host_leaves, _treedef = jax.tree.flatten(state_tree)
     host_leaves = jax.device_get(host_leaves)
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(host_leaves)}
     exp_bytes = json.dumps(experiment_state, default=float).encode()
+    return CheckpointSnapshot(arrays, exp_bytes, _tree_fingerprint(state_tree))
+
+
+def write_snapshot(
+    filepath: str,
+    snapshot: CheckpointSnapshot,
+    *,
+    retries: int = WRITE_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+    t_start: float | None = None,
+) -> str:
+    """The background-safe half: manifest (per-leaf CRC32) + npz serialize
+    + atomic tmp+rename, retrying transient ``OSError`` up to ``retries``
+    total attempts with exponential backoff. Byte-compatible with the
+    pre-split ``save_checkpoint`` archives."""
+    if t_start is None:
+        t_start = time.perf_counter()
+    arrays = dict(snapshot.arrays)
+    exp_bytes = snapshot.exp_bytes
     manifest = {
         "schema": SCHEMA_VERSION,
-        "leaf_count": len(host_leaves),
+        "leaf_count": len(arrays),
         "leaf_crc32": [_leaf_crc(a) for a in arrays.values()],
-        "tree_crc32": _tree_fingerprint(state_tree),
+        "tree_crc32": snapshot.tree_crc32,
         "experiment_crc32": zlib.crc32(exp_bytes),
     }
     arrays[_EXPERIMENT_KEY] = np.frombuffer(exp_bytes, dtype=np.uint8)
@@ -171,6 +204,176 @@ def save_checkpoint(
         attempts=attempt + 1,
     )
     return filepath
+
+
+def save_checkpoint(
+    filepath: str,
+    state_tree: Tree,
+    experiment_state: dict,
+    *,
+    retries: int = WRITE_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> str:
+    """Writes leaves + experiment state + integrity manifest to ``filepath``
+    (no extension added), atomically, retrying transient ``OSError`` up to
+    ``retries`` total attempts with exponential backoff — the synchronous
+    composition of ``snapshot_for_save`` + ``write_snapshot``."""
+    t_start = time.perf_counter()
+    snapshot = snapshot_for_save(state_tree, experiment_state)
+    return write_snapshot(
+        filepath, snapshot, retries=retries, backoff_s=backoff_s,
+        t_start=t_start,
+    )
+
+
+class AsyncCheckpointWriter:
+    """Single background writer thread with a bounded queue: serialize +
+    CRC + atomic rename run off the train loop's critical path; the loop
+    pays only the ``snapshot_for_save`` device fetch.
+
+    Contract (the PR 3 integrity/atomicity story, preserved):
+
+    * jobs complete IN ORDER on one thread — an epoch file and its
+      ``latest`` alias publish in the order submitted, never interleaved;
+    * ``submit`` blocks when ``max_pending`` jobs are queued (bounds host
+      memory to a couple of snapshots) and re-raises the first writer
+      error (the retry-exhausted ``OSError`` the sync path would have
+      raised at the same boundary, one epoch later);
+    * ``drain`` blocks until the writer is idle — the FENCE every exit
+      path runs before touching ``latest`` (emergency write, rollback
+      reload, test-ensemble load, process exit), so a half-written async
+      archive can never race a foreground read or write. A process killed
+      without draining (SIGKILL, watchdog ``os._exit``) leaves at most an
+      orphaned ``.tmp`` — the atomic-rename contract keeps every
+      published file valid.
+    """
+
+    def __init__(self, *, max_pending: int = 2):
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.max_pending = int(max_pending)
+        self._cond = threading.Condition()
+        self._jobs: list = []
+        self._busy = False
+        self._error: BaseException | None = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="async-checkpoint-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            error, self._error = self._error, None
+        if error is not None:
+            raise error
+
+    def submit(
+        self,
+        filepath: str,
+        snapshot: CheckpointSnapshot,
+        alias_dst: str | None = None,
+        *,
+        retries: int = WRITE_RETRIES,
+        backoff_s: float = WRITE_BACKOFF_S,
+    ) -> None:
+        """Enqueues one write (plus optional ``latest``-alias publish).
+        Blocks while ``max_pending`` jobs are in flight; raises any earlier
+        writer error first (so a failed epoch write surfaces at the next
+        boundary, exactly like the sync path's raise)."""
+        self._raise_pending_error()
+        with self._cond:
+            if self._closed:
+                raise CheckpointError(
+                    "AsyncCheckpointWriter is closed; cannot submit "
+                    f"{filepath}"
+                )
+            while len(self._jobs) >= self.max_pending and not self._closed:
+                self._cond.wait()
+            if self._closed:
+                raise CheckpointError(
+                    "AsyncCheckpointWriter closed while waiting to submit "
+                    f"{filepath}"
+                )
+            self._jobs.append((filepath, snapshot, alias_dst, retries, backoff_s))
+            self._cond.notify_all()
+
+    def drain(
+        self, raise_errors: bool = True, timeout: float | None = None
+    ) -> bool:
+        """Blocks until every submitted write (and alias publish) has
+        completed — the pre-``latest`` fence. With ``raise_errors`` the
+        first writer failure is re-raised here; the emergency-exit path
+        passes False (it must still attempt its own last-line write).
+        ``timeout`` bounds the wait (the watchdog's graceful unwind must
+        not hang behind a wedged writer); returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._jobs or self._busy:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        if raise_errors:
+            self._raise_pending_error()
+        return True
+
+    @property
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._jobs) + (1 if self._busy else 0)
+
+    def pending_error(self) -> BaseException | None:
+        with self._cond:
+            return self._error
+
+    def close(self) -> None:
+        """Drains (errors kept readable via ``pending_error``), stops and
+        joins the writer thread. Idempotent."""
+        self.drain(raise_errors=False)
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=10.0)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._jobs and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._jobs:
+                    return
+                filepath, snapshot, alias_dst, retries, backoff_s = (
+                    self._jobs.pop(0)
+                )
+                self._busy = True
+                self._cond.notify_all()
+            try:
+                write_snapshot(
+                    filepath, snapshot, retries=retries, backoff_s=backoff_s
+                )
+                if alias_dst is not None:
+                    publish_alias(
+                        filepath, alias_dst, retries=retries,
+                        backoff_s=backoff_s,
+                    )
+            except BaseException as exc:  # noqa: BLE001 — surfaced at drain
+                with self._cond:
+                    if self._error is None:
+                        self._error = exc
+                telemetry_events.emit(
+                    "checkpoint_async_error",
+                    path=os.path.basename(filepath),
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
 
 
 def publish_alias(

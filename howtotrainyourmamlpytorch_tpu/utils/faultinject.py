@@ -19,7 +19,20 @@ This module provides the failure points those tests drive:
   the compute dtype — the bf16-path sentinel proof (bf16 shares f32's
   exponent range, so the fault fires on either compute dtype);
 * ``sigterm_at_iter`` — deliver ``SIGTERM`` to this process right after
-  iteration I's dispatch completes (TPU preemption).
+  iteration I's dispatch completes (TPU preemption);
+* ``sigkill_at_iter`` — deliver ``SIGKILL`` instead: a mesh-worker death
+  (no handler runs, no emergency checkpoint — resume replays from the last
+  published checkpoint);
+* ``hang_at_iter`` — WEDGE the dispatch thread at iteration I: the thread
+  parks inside the watchdog-armed window exactly like a stuck collective,
+  so ``utils/watchdog.py`` detection + the distinct requeue-degraded exit
+  code are provable deterministically (the stall only ends when the
+  watchdog's ``exit_fn`` terminates the process);
+* ``producer_fail_at_iter`` — raise a transient ``OSError`` inside the
+  device-prefetch stager while pulling the batch planned for iteration I
+  (loader I/O blip / one corrupt episode), driving the stager's
+  retry-then-skip quarantine policy — or its fail-fast branch when the
+  quarantine budget is exhausted.
 
 Serve-path faults (the resilience layer's recovery paths, ``serve/pool.py``
 and ``serve/resilience`` — mirrored onto the request path exactly like the
@@ -76,6 +89,9 @@ class FaultPlan:
     nan_at_iter: int | None = None
     overflow_at_iter: int | None = None
     sigterm_at_iter: int | None = None
+    sigkill_at_iter: int | None = None
+    hang_at_iter: int | None = None
+    producer_fail_at_iter: int | None = None
     replica_kill_at_request: int | None = None
     wedge_replica_at_request: int | None = None
     corrupt_swap_at: int | None = None
@@ -223,15 +239,71 @@ def poison_batches(samples, first_iter: int):
 
 
 def sigterm_due(iters_done: int) -> None:
-    """Delivers SIGTERM to this process once ``iters_done`` reaches the
-    planned ``sigterm_at_iter`` (count of completed iterations)."""
+    """Delivers SIGTERM (or SIGKILL — the mesh-worker-death variant) to
+    this process once ``iters_done`` reaches the planned iteration count.
+    SIGKILL is immediate and unhandleable by design: the process dies with
+    no emergency checkpoint, exactly like a mesh worker losing its host."""
     plan = _active()
-    if plan is None or plan.sigterm_at_iter is None:
+    if plan is None:
+        return
+    if (
+        plan.sigkill_at_iter is not None
+        and iters_done >= plan.sigkill_at_iter
+    ):
+        plan.sigkill_at_iter = None
+        events.append(f"sigkill:{iters_done}")
+        os.kill(os.getpid(), signal.SIGKILL)
+    if plan.sigterm_at_iter is None:
         return
     if iters_done >= plan.sigterm_at_iter:
         plan.sigterm_at_iter = None
         events.append(f"sigterm:{iters_done}")
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+#: Safety cap on the injected dispatch stall: the watchdog is expected to
+#: terminate the process long before this; the cap only bounds a test
+#: where detection itself is broken.
+HANG_STALL_CAP_S = 3600.0
+
+
+def hang_due(current_iter: int) -> None:
+    """Wedges the CALLING thread once ``current_iter`` reaches the planned
+    ``hang_at_iter`` (>= — the builder calls this with dispatch-GROUP
+    start iterations, so a plan landing mid-group wedges that group's
+    dispatch instead of silently never firing): parks in a sleep loop
+    inside the watchdog-armed dispatch window, exactly like a stuck
+    collective. The stall ends only when the watchdog's ``exit_fn``
+    terminates the process (or the safety cap expires)."""
+    plan = _active()
+    if plan is None or plan.hang_at_iter is None:
+        return
+    if current_iter < plan.hang_at_iter:
+        return
+    plan.hang_at_iter = None
+    events.append(f"hang:{current_iter}")
+    import time
+
+    deadline = time.monotonic() + HANG_STALL_CAP_S
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+
+
+def producer_pull(current_iter: int) -> None:
+    """Called by the device-prefetch stager before pulling the batch group
+    planned for ``current_iter``; raises the injected transient loader
+    error when that iteration is the planned ``producer_fail_at_iter``
+    (one-shot)."""
+    plan = _active()
+    if plan is None or plan.producer_fail_at_iter is None:
+        return
+    if current_iter < plan.producer_fail_at_iter:
+        return
+    plan.producer_fail_at_iter = None
+    events.append(f"producer-fail:{current_iter}")
+    raise OSError(
+        errno.EIO, "faultinject: injected transient episode-producer failure"
+    )
 
 
 # ---------------------------------------------------------------------------

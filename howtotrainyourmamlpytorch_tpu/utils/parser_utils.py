@@ -229,6 +229,45 @@ def get_parser() -> argparse.ArgumentParser:
              "keep training; rollback: reload the last valid checkpoint and "
              "fast-forward the data seed window past the offending batch. "
              "Trips are counted in the train metrics either way")
+    # Training-side resilience layer (utils/watchdog.py, async
+    # checkpointing, data-fault quarantine — README "Fault tolerance").
+    add("--watchdog", type=str, default="True",
+        help="dispatch hang/straggler watchdog: a monitor thread armed "
+             "around every device dispatch; on deadline expiry it dumps "
+             "all thread stacks (logs/hang_stacks.txt + a 'hang' "
+             "telemetry event) and exits with the requeue-degraded code "
+             "76 — distinct from the preemption requeue 75, so the "
+             "dispatcher resumes hung runs on a smaller mesh instead of "
+             "the same (suspect) topology")
+    add("--watchdog_min_s", type=float, default=600.0,
+        help="watchdog deadline floor in seconds; the effective deadline "
+             "is max(this, watchdog_factor x the observed per-dispatch "
+             "p95 wall time). Generous by default so cold-start XLA "
+             "compiles can never false-trip it (the first dispatch "
+             "sample is excluded from the distribution too)")
+    add("--watchdog_factor", type=float, default=20.0,
+        help="watchdog deadline multiple over the observed per-dispatch "
+             "p95 wall time")
+    add("--checkpoint_async", type=str, default="True",
+        help="background checkpoint writer: the train loop pays only the "
+             "state snapshot (one batched device_get); manifest/CRC/"
+             "serialize/atomic-rename run on a single writer thread, "
+             "drained (fenced) on every exit path so the emergency "
+             "'latest' write can never race an in-flight epoch write. "
+             "False restores the fully synchronous PR 3 writer")
+    add("--checkpoint_interval_s", type=float, default=0.0,
+        help="time-based mid-epoch checkpoint cadence in seconds (0 = "
+             "off): writes the full resume-compatible state to "
+             "train_model_latest every N seconds, bounding the recovery "
+             "point age on long epochs (a preemption/crash/hang then "
+             "loses at most the cadence, not the epoch)")
+    add("--data_fault_budget", type=int, default=8,
+        help="transient episode-producer faults (loader I/O blip, one "
+             "corrupt episode) tolerated per stager: each is skipped "
+             "with a data_fault telemetry event and training continues "
+             "on the next batch; past the budget the original exception "
+             "fails the run fast (traceback chained). 0 = fail fast on "
+             "the first fault")
     add("--resnet_widths", nargs="+", type=int, default=None,
         help="4 stage widths for architecture_name=resnet12 (default "
              "cnn_num_filters x 1/2/4/8; MetaOptNet uses 64 160 320 640)")

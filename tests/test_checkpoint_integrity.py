@@ -349,3 +349,117 @@ def test_load_for_inference_typed_errors(tmp_path):
         f.truncate(os.path.getsize(path) // 2)
     with pytest.raises(CheckpointCorruptError):
         load_for_inference(path, _list_tree(n=2))
+
+
+# ---------------------------------------------------------------------------
+# Async background writer (ISSUE 10): snapshot on the critical path,
+# serialize/CRC/rename on one writer thread, drained on every exit path
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_plus_write_is_byte_compatible_with_sync_save(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        snapshot_for_save,
+        write_snapshot,
+    )
+
+    sync_path = str(tmp_path / "sync")
+    split_path = str(tmp_path / "split")
+    save_checkpoint(sync_path, _tree(3), {"current_iter": 3})
+    write_snapshot(split_path, snapshot_for_save(_tree(3), {"current_iter": 3}))
+    with open(sync_path, "rb") as a, open(split_path, "rb") as b:
+        assert a.read() == b.read()
+    leaves, exp = load_checkpoint(split_path, _tree(0))
+    assert exp["current_iter"] == 3
+
+
+def test_async_writer_publishes_in_order_with_alias_and_drains(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        AsyncCheckpointWriter,
+        load_checkpoint,
+        snapshot_for_save,
+    )
+
+    writer = AsyncCheckpointWriter()
+    try:
+        for epoch in (1, 2):
+            writer.submit(
+                str(tmp_path / f"ckpt_{epoch}"),
+                snapshot_for_save(_tree(epoch), {"current_iter": epoch}),
+                alias_dst=str(tmp_path / "latest"),
+            )
+        assert writer.drain()
+        # Both epochs valid; the alias is the LAST submitted epoch.
+        for epoch in (1, 2):
+            _, exp = load_checkpoint(str(tmp_path / f"ckpt_{epoch}"), _tree(0))
+            assert exp["current_iter"] == epoch
+        _, exp = load_checkpoint(str(tmp_path / "latest"), _tree(0))
+        assert exp["current_iter"] == 2
+        assert writer.pending == 0
+    finally:
+        writer.close()
+
+
+def test_async_writer_error_surfaces_at_next_submit_boundary(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        AsyncCheckpointWriter,
+        snapshot_for_save,
+    )
+
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=99))
+    writer = AsyncCheckpointWriter()
+    writer.submit(
+        str(tmp_path / "doomed"),
+        snapshot_for_save(_tree(1), {"current_iter": 1}),
+        backoff_s=0.01,
+    )
+    # The non-raising drain (the emergency-exit fence) completes and KEEPS
+    # the error readable; the raising drain then surfaces the
+    # retry-exhausted OSError the sync path would have raised.
+    assert writer.drain(raise_errors=False) is True
+    assert isinstance(writer.pending_error(), OSError)
+    with pytest.raises(OSError, match="faultinject"):
+        writer.drain()
+    faultinject.deactivate()
+    # After surfacing once the writer is usable again.
+    writer.submit(
+        str(tmp_path / "fine"), snapshot_for_save(_tree(2), {"current_iter": 2})
+    )
+    writer.drain()
+    _, exp = load_checkpoint(str(tmp_path / "fine"), _tree(0))
+    assert exp["current_iter"] == 2
+    writer.close()
+    with pytest.raises(CheckpointError, match="closed"):
+        writer.submit(
+            str(tmp_path / "late"),
+            snapshot_for_save(_tree(3), {"current_iter": 3}),
+        )
+
+
+def test_async_writer_drain_timeout_bounds_the_wait(tmp_path, monkeypatch):
+    import threading
+
+    import howtotrainyourmamlpytorch_tpu.utils.checkpoint as ckpt
+
+    release = threading.Event()
+    real_write = ckpt.write_snapshot
+
+    def slow_write(path, snapshot, **kw):
+        release.wait(timeout=30.0)
+        return real_write(path, snapshot, **kw)
+
+    monkeypatch.setattr(ckpt, "write_snapshot", slow_write)
+    writer = ckpt.AsyncCheckpointWriter()
+    try:
+        writer.submit(
+            str(tmp_path / "slow"),
+            ckpt.snapshot_for_save(_tree(1), {"current_iter": 1}),
+        )
+        assert writer.drain(timeout=0.2) is False  # bounded: still in flight
+        release.set()
+        assert writer.drain(timeout=30.0) is True
+        _, exp = load_checkpoint(str(tmp_path / "slow"), _tree(0))
+        assert exp["current_iter"] == 1
+    finally:
+        release.set()
+        writer.close()

@@ -215,6 +215,14 @@ def test_staged_k_scan_mints_no_new_signatures(compile_guard):
 
 
 def test_producer_error_propagates_to_consumer():
+    """A producer death surfaces at the consumer's next pop as a typed
+    DataPipelineError with the ORIGINAL exception — and its producer-side
+    traceback — chained as __cause__ (previously an opaque re-raise that
+    read as if the consumer itself failed)."""
+    from howtotrainyourmamlpytorch_tpu.data.device_prefetch import (
+        DataPipelineError,
+    )
+
     def exploding():
         yield from make_samples(np.random.RandomState(5), 1)
         raise ValueError("corrupt image mid-epoch")
@@ -224,11 +232,88 @@ def test_producer_error_propagates_to_consumer():
     )
     try:
         next(stager)
-        with pytest.raises(ValueError, match="corrupt image"):
+        with pytest.raises(DataPipelineError, match="corrupt image") as exc:
             for _ in stager:
                 pass
+        cause = exc.value.__cause__
+        assert isinstance(cause, ValueError)
+        # The chained traceback reaches the producer-side raise site.
+        frames = []
+        tb = cause.__traceback__
+        while tb is not None:
+            frames.append(tb.tb_frame.f_code.co_name)
+            tb = tb.tb_next
+        assert "exploding" in frames
     finally:
         stager.close()
+
+
+def _data_fault_events(log, path):
+    log.flush()
+    import json
+
+    with open(path) as f:
+        return [
+            e for e in (json.loads(line) for line in f if line.strip())
+            if e.get("type") == "data_fault"
+        ]
+
+
+def test_producer_fault_quarantine_skips_then_fails_past_budget(tmp_path):
+    """With fault_budget > 0, a transient producer fault (the
+    producer_fail_at_iter injection — raised before the source pull, like
+    a loader I/O blip) is quarantined with a data_fault telemetry event
+    and the stream continues; a persistently failing stage exhausts the
+    budget and fails fast with the original error chained."""
+    from howtotrainyourmamlpytorch_tpu.data.device_prefetch import (
+        DataPipelineError,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry import events as tel_events
+    from howtotrainyourmamlpytorch_tpu.utils import faultinject
+
+    log_path = str(tmp_path / "events.jsonl")
+    log = tel_events.EventLog(log_path)
+    prev = tel_events.install(log)
+    try:
+        # Injected transient pull fault, budget 2: quarantined, every
+        # batch still arrives (the pull retries on the intact source).
+        faultinject.activate(faultinject.FaultPlan(producer_fail_at_iter=2))
+        stager = DevicePrefetcher(
+            iter(make_samples(np.random.RandomState(7), 6)),
+            lambda b: prepare_batch(b), depth=2, group=1, fault_budget=2,
+        )
+        try:
+            assert sum(1 for _ in stager) == 6
+            assert stager.faults_quarantined == 1
+        finally:
+            stager.close()
+            faultinject.deactivate()
+        faults = _data_fault_events(log, log_path)
+        assert faults and not faults[0]["fatal"]
+
+        # A persistently failing stage: two quarantined skips (each
+        # consuming one batch window), then fail-fast with the original
+        # OSError chained.
+        def bad_stage(b):
+            raise OSError(5, "corrupt episode")
+
+        stager = DevicePrefetcher(
+            iter(make_samples(np.random.RandomState(8), 6)),
+            bad_stage, depth=2, group=1, fault_budget=2,
+        )
+        try:
+            with pytest.raises(
+                DataPipelineError, match="corrupt episode"
+            ) as exc:
+                for _ in stager:
+                    pass
+            assert isinstance(exc.value.__cause__, OSError)
+            assert stager.faults_quarantined == 2
+        finally:
+            stager.close()
+        assert any(e["fatal"] for e in _data_fault_events(log, log_path))
+    finally:
+        tel_events.install(prev)
 
 
 def test_close_stops_thread_and_releases_device_buffers():
@@ -319,6 +404,7 @@ def test_builder_mesh_staging_follows_learner_declaration():
 
     builder = Stub()
     builder.device_prefetch = -1
+    builder.data_fault_budget = 0
     builder._use_multi = False
     builder.iters_per_dispatch = 1
     builder.state = {"current_iter": 0}

@@ -1,0 +1,208 @@
+"""Dispatcher supervision-policy tests (train_maml_system_dispatch.py).
+
+The dispatcher supervises the training process like ``serve/pool.py``
+supervises replicas. These tests pin the POLICY — exit-code routing,
+per-class budgets, degraded-mesh resume, the re-promotion probe, one-shot
+env fault plans — against a scripted stub entry (``MAML_DISPATCH_ENTRY``)
+that exits with planned codes and writes planned progress, so the policy is
+provable in milliseconds without compiling a single XLA program. The real
+end-to-end story (an actually wedged dispatch in the real CLI, detected by
+the watchdog, resumed on a smaller virtual mesh) lives in
+``tests/test_chaos_train.py``.
+
+Pinned here:
+
+* rc 75 (preemption requeue) re-enters on the SAME mesh and draws only on
+  the requeue budget; rc 76 (watchdog hang) degrades the mesh and draws
+  only on the hang budget — the code split means the two failure classes
+  cannot starve each other's recovery;
+* degrade steps dp 8 -> 4 -> 2 -> 1 honoring global-meta-batch
+  divisibility, with an audit row per transition;
+* two signal deaths in a row are treated like a hang (a crashing device
+  looks like a dying worker, not a preemption);
+* after a clean phase on a degraded mesh, the re-promotion probe restores
+  the next-larger extent;
+* ``MAML_FAULTS`` is consumed by the first phase only.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+import train_maml_system_dispatch as dispatch
+
+
+STUB = textwrap.dedent(
+    """
+    import argparse, json, os, sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--name_of_args_json_file")
+    args, _ = parser.parse_known_args()
+    with open(args.name_of_args_json_file) as f:
+        cfg = json.load(f)
+
+    plan_path = os.environ["STUB_PLAN"]
+    with open(plan_path) as f:
+        plan = json.load(f)
+    step = plan.pop(0)
+    with open(plan_path, "w") as f:
+        json.dump(plan, f)
+
+    with open(os.environ["STUB_LOG"], "a") as f:
+        f.write(json.dumps({
+            "dp": cfg.get("data_parallel_devices"),
+            "faults": os.environ.get("MAML_FAULTS"),
+        }) + "\\n")
+
+    logs = os.path.join(cfg["experiment_name"], "logs")
+    os.makedirs(logs, exist_ok=True)
+    summary = os.path.join(logs, "summary_statistics.csv")
+    for _ in range(step.get("epochs", 0)):
+        if not os.path.exists(summary):
+            with open(summary, "w") as f:
+                f.write("epoch\\n")
+        with open(summary, "a") as f:
+            f.write("1\\n")
+    if step.get("test_eval"):
+        with open(os.path.join(logs, "test_summary.csv"), "w") as f:
+            f.write("ok\\n")
+    sys.exit(step.get("rc", 0))
+    """
+)
+
+
+@pytest.fixture
+def harness(tmp_path, monkeypatch):
+    """Chdir'd scratch repo layout + scripted stub entry; returns a driver
+    ``run(plan, cfg_overrides, *extra_argv)`` -> (exit code, invocations,
+    audit rows)."""
+    monkeypatch.chdir(tmp_path)
+    stub_path = tmp_path / "stub_entry.py"
+    stub_path.write_text(STUB)
+    monkeypatch.setenv(dispatch.ENTRY_ENV, str(stub_path))
+    plan_path = tmp_path / "plan.json"
+    log_path = tmp_path / "invocations.jsonl"
+    monkeypatch.setenv("STUB_PLAN", str(plan_path))
+    monkeypatch.setenv("STUB_LOG", str(log_path))
+    (tmp_path / "experiment_config").mkdir()
+
+    def run(plan, cfg_overrides=None, *extra_argv):
+        cfg = {
+            "experiment_name": "exp",
+            "total_epochs": 2,
+            "num_of_gpus": 1,
+            "batch_size": 4,
+            "samples_per_iter": 1,
+            "data_parallel_devices": 4,
+        }
+        cfg.update(cfg_overrides or {})
+        with open(tmp_path / "experiment_config" / "chaostest.json", "w") as f:
+            json.dump(cfg, f)
+        plan_path.write_text(json.dumps(plan))
+        if log_path.exists():
+            log_path.unlink()
+        monkeypatch.setattr(
+            sys, "argv", ["train_maml_system_dispatch.py", "chaostest",
+                          *extra_argv]
+        )
+        rc = dispatch.main()
+        calls = [
+            json.loads(line)
+            for line in log_path.read_text().splitlines()
+        ] if log_path.exists() else []
+        audit_path = tmp_path / "exp" / "logs" / "interruptions.csv"
+        audit = (
+            audit_path.read_text().splitlines()[1:]
+            if audit_path.exists() else []
+        )
+        return rc, calls, audit
+
+    return run
+
+
+def test_hang_degrades_mesh_requeue_does_not_then_repromotes(harness):
+    rc, calls, audit = harness([
+        {"rc": dispatch.HANG_EXIT_CODE},            # hang -> dp4 -> dp2
+        {"rc": dispatch.REQUEUE_EXIT_CODE},         # preemption: SAME mesh
+        {"rc": 0, "epochs": 1},                     # progress -> probe up
+        {"rc": 0, "epochs": 1, "test_eval": True},  # finish on dp4
+    ])
+    assert rc == 0
+    assert [c["dp"] for c in calls] == [4, 2, 2, 4]
+    kinds = [row.split(",")[1] for row in audit]
+    assert "hang-degrade:dp4->dp2" in kinds
+    assert "probe-promote:dp4" in kinds
+
+
+def test_budgets_are_split_and_hang_budget_bounds_the_loop(harness):
+    # dp1 with global batch 4: no smaller viable mesh, so hangs requeue on
+    # the same topology — and the hang BUDGET (not the requeue or phase
+    # budget) bounds the loop. The preceding requeue exits must not
+    # consume it.
+    rc, calls, audit = harness(
+        [
+            {"rc": dispatch.REQUEUE_EXIT_CODE},
+            {"rc": dispatch.REQUEUE_EXIT_CODE},
+            {"rc": dispatch.REQUEUE_EXIT_CODE},
+            {"rc": dispatch.HANG_EXIT_CODE},
+            {"rc": dispatch.HANG_EXIT_CODE},
+        ],
+        {"data_parallel_devices": 1},
+        "--max_hangs", "2",
+    )
+    assert rc == dispatch.HANG_EXIT_CODE
+    assert len(calls) == 5  # 3 requeues rode the requeue budget, 2 hangs
+    kinds = [row.split(",")[1] for row in audit]
+    assert kinds.count("hang-requeue:dp1") == 2
+
+
+def test_requeue_budget_bounds_a_preemption_loop(harness):
+    rc, calls, _ = harness(
+        [{"rc": dispatch.REQUEUE_EXIT_CODE}] * 3,
+        None,
+        "--max_requeues", "2",
+    )
+    assert rc == dispatch.REQUEUE_EXIT_CODE
+    assert len(calls) == 2
+
+
+def test_repeated_signal_death_degrades_like_a_hang(harness):
+    rc, calls, audit = harness([
+        {"rc": 137},  # SIGKILLed worker: one death could be anything
+        {"rc": 137},  # two in a row: suspect the topology
+        {"rc": 0, "epochs": 2, "test_eval": True},
+    ])
+    assert rc == 0
+    assert [c["dp"] for c in calls] == [4, 4, 2]
+    kinds = [row.split(",")[1] for row in audit]
+    assert "repeated-signal-death-degrade:dp4->dp2" in kinds
+
+
+def test_degrade_honors_global_batch_divisibility(harness):
+    # Global meta-batch 6 on dp6: 3 divides, 2 divides, but the half-step
+    # search goes 6 -> 3 (first divisor on the way down) — never an extent
+    # the meta-batch cannot shard over.
+    rc, calls, _ = harness(
+        [
+            {"rc": dispatch.HANG_EXIT_CODE},
+            {"rc": 0, "epochs": 2, "test_eval": True},
+        ],
+        {"data_parallel_devices": 6, "batch_size": 6},
+    )
+    assert rc == 0
+    assert [c["dp"] for c in calls] == [6, 3]
+
+
+def test_env_fault_plan_is_consumed_by_first_phase_only(harness, monkeypatch):
+    monkeypatch.setenv("MAML_FAULTS", "hang_at_iter=3")
+    rc, calls, _ = harness([
+        {"rc": dispatch.HANG_EXIT_CODE},
+        {"rc": 0, "epochs": 2, "test_eval": True},
+    ])
+    assert rc == 0
+    assert calls[0]["faults"] == "hang_at_iter=3"
+    assert calls[1]["faults"] is None  # a degraded phase replays clean

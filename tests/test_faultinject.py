@@ -407,3 +407,128 @@ def test_sentinel_rollback_reloads_and_fastforwards_data(dataset_env):
     # CSV, only its clean replay did.
     stats = storage.load_statistics(str(tmp / "exp" / "logs"))
     assert len(stats["epoch"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: async checkpointing x preemption — the exit-path fence
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_with_async_epoch_write_in_flight_fences_then_bitexact(
+    dataset_env, monkeypatch
+):
+    """SIGTERM arriving while the async checkpoint writer is mid-flight:
+    the emergency ``latest`` write must WAIT for (fence) the in-flight
+    epoch write — no torn archive, no stale alias clobbering the newer
+    emergency state — and kill-and-resume stays bit-exact.
+
+    The in-flight window is forced deterministically: the background half
+    of every checkpoint write is slowed by ~1s, so epoch 1's async write
+    (submitted at the iter-2 boundary) is still in flight when the
+    injected SIGTERM lands after iter 3."""
+    import time as _time
+
+    import howtotrainyourmamlpytorch_tpu.utils.checkpoint as ckpt
+
+    tmp = dataset_env
+    real_write = ckpt.write_snapshot
+
+    def slow_write(path, snapshot, **kw):
+        _time.sleep(1.0)
+        return real_write(path, snapshot, **kw)
+
+    monkeypatch.setattr(ckpt, "write_snapshot", slow_write)
+
+    # Run A: uninterrupted twin (same slow writer; params unaffected).
+    with pytest.raises(SystemExit) as exit_a:
+        _builder(
+            _exp_args(tmp, "exp_a", total_epochs_before_pause=2)
+        ).run_experiment()
+    assert exit_a.value.code is None
+    leaves_a, state_a = _ckpt(
+        str(tmp / "exp_a" / "saved_models" / "train_model_latest")
+    )
+    assert state_a["current_iter"] == 4
+
+    # Run B: SIGTERM after iter 3, epoch-1 async write still in flight.
+    faultinject.activate(faultinject.FaultPlan(sigterm_at_iter=3))
+    builder_b = _builder(_exp_args(tmp, "exp_b"))
+    with pytest.raises(SystemExit) as exit_b:
+        builder_b.run_experiment()
+    assert exit_b.value.code == REQUEUE_EXIT_CODE
+    faultinject.deactivate()
+
+    saved_b = str(tmp / "exp_b" / "saved_models")
+    # The fenced ordering held: the epoch-1 archive fully published (valid
+    # manifest, iter 2), and ``latest`` is the NEWER emergency state (iter
+    # 3) — not the async alias of epoch 1, and not a torn write.
+    _, state_epoch1 = _ckpt(os.path.join(saved_b, "train_model_1"))
+    assert state_epoch1["current_iter"] == 2
+    _, state_latest = _ckpt(os.path.join(saved_b, "train_model_latest"))
+    assert state_latest["current_iter"] == 3
+    assert not os.path.exists(
+        os.path.join(saved_b, "train_model_latest.tmp")
+    )
+
+    # Kill-and-resume is bit-exact vs the uninterrupted twin.
+    builder_b2 = _builder(
+        _exp_args(tmp, "exp_b", total_epochs_before_pause=1)
+    )
+    assert builder_b2.state["current_iter"] == 3
+    with pytest.raises(SystemExit):
+        builder_b2.run_experiment()
+    leaves_b, state_b = _ckpt(os.path.join(saved_b, "train_model_latest"))
+    assert state_b["current_iter"] == 4
+    assert set(leaves_b) == set(leaves_a)
+    for key in leaves_a:
+        np.testing.assert_array_equal(leaves_a[key], leaves_b[key])
+
+
+def test_checkpoint_interval_cadence_bounds_rpo(dataset_env):
+    """``--checkpoint_interval_s``: a time-based mid-epoch write of the
+    full resume-compatible state to ``train_model_latest`` — a crash/kill
+    then loses at most the cadence, not the whole epoch. With a ~0
+    interval, every non-boundary dispatch writes one (iters 1 and 3 of
+    the 2x2 run); the write goes through the async writer and is
+    resume-loadable."""
+    tmp = dataset_env
+    builder = _builder(
+        _exp_args(tmp, total_epochs_before_pause=2,
+                  checkpoint_interval_s=1e-4)
+    )
+    with pytest.raises(SystemExit) as exits:
+        builder.run_experiment()
+    assert exits.value.code is None  # clean pause
+    events = [
+        json.loads(line)
+        for line in open(str(tmp / "exp" / "logs" / "telemetry.jsonl"))
+        if line.strip()
+    ]
+    intervals = [e for e in events if e["type"] == "checkpoint_interval"]
+    assert [e["iter"] for e in intervals] == [1, 3]
+    # The final latest is the epoch-2 alias (published after iter 4).
+    _, state = _ckpt(
+        str(tmp / "exp" / "saved_models" / "train_model_latest")
+    )
+    assert state["current_iter"] == 4
+
+    # The interval write itself is the emergency-write form: resume-
+    # compatible, through the async writer.
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        AsyncCheckpointWriter,
+    )
+
+    builder2 = _builder(_exp_args(tmp, name="exp2"))
+    builder2._ckpt_writer = AsyncCheckpointWriter()
+    try:
+        builder2._interval_checkpoint()
+        builder2._ckpt_writer.drain()
+    finally:
+        builder2._ckpt_writer.close()
+        builder2._ckpt_writer = None
+    _, state2 = _ckpt(
+        str(tmp / "exp2" / "saved_models" / "train_model_latest")
+    )
+    assert state2["current_iter"] == 0
+    resumed = _builder(_exp_args(tmp, name="exp2"))
+    assert resumed.state["current_iter"] == 0

@@ -561,3 +561,87 @@ def test_device_op_mesh_aware_staging_does_not_widen_the_data_path_ban():
     assert "device-op-in-data-path" not in rules_of(
         sharding_aware_put, path="pkg/data/device_prefetch.py"
     )
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_thread_lifecycle_positive_class_spawns_without_join():
+    src = """
+    import threading
+
+    class LeakyWorker:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def _run(self):
+            pass
+
+        def close(self):
+            self._closed = True  # never joins the thread
+    """
+    assert "thread-lifecycle" in rules_of(src)
+
+
+def test_thread_lifecycle_positive_module_level_retained_thread():
+    src = """
+    from threading import Thread
+
+    def start_background(fn):
+        worker = Thread(target=fn, daemon=True)
+        worker.start()
+        return worker
+    """
+    assert "thread-lifecycle" in rules_of(src)
+
+
+def test_thread_lifecycle_negative_owner_joins_on_close():
+    src = """
+    import threading
+
+    class Supervised:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def _run(self):
+            pass
+
+        def close(self):
+            self._thread.join(timeout=5.0)
+    """
+    assert "thread-lifecycle" not in rules_of(src)
+
+
+def test_thread_lifecycle_negative_string_and_path_joins_dont_count():
+    src = """
+    import os
+    import threading
+
+    class StillLeaky:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            pass
+
+        def describe(self):
+            return ", ".join(["a", "b"]) + os.path.join("x", "y")
+    """
+    # String/path joins are not thread joins: the rule still fires.
+    assert "thread-lifecycle" in rules_of(src)
+
+
+def test_thread_lifecycle_negative_fire_and_forget_out_of_scope():
+    src = """
+    import threading
+
+    def notify(fn):
+        threading.Thread(target=fn, daemon=True).start()
+    """
+    # No retained handle -> nothing a shutdown path could join.
+    assert "thread-lifecycle" not in rules_of(src)

@@ -1,0 +1,183 @@
+"""Dispatch hang watchdog unit tests (utils/watchdog.py).
+
+The e2e story — a deterministically wedged dispatch in the real CLI being
+detected, diagnosed and requeued-degraded by the dispatcher — lives in
+``tests/test_chaos_train.py``; here the watchdog's own contracts are pinned
+with an injectable ``exit_fn`` (so a firing is observable without dying)
+and real-but-short deadlines:
+
+* the deadline model: floor + factor x p95 of observed samples, with the
+  FIRST sample (the XLA compile) excluded;
+* expiry -> full thread-stack dump (file + ``hang`` telemetry event with
+  the distinct exit code) -> owner's unwind hook -> ``exit_fn``;
+* a dispatch that completes inside its deadline never fires, and its wall
+  time feeds the distribution;
+* the exit-code split itself: 76 (hang: requeue, suspect the topology) is
+  distinct from 75 (preemption: requeue, same mesh) — the dispatcher
+  budgets them separately.
+"""
+
+import os
+import threading
+import time
+
+from howtotrainyourmamlpytorch_tpu.telemetry import events as telemetry_events
+from howtotrainyourmamlpytorch_tpu.utils.watchdog import (
+    HANG_EXIT_CODE,
+    DispatchWatchdog,
+    dump_all_stacks,
+)
+
+
+def test_exit_code_split_is_pinned():
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        REQUEUE_EXIT_CODE,
+    )
+    import train_maml_system_dispatch as dispatch
+
+    assert HANG_EXIT_CODE == 76
+    assert REQUEUE_EXIT_CODE == 75
+    assert HANG_EXIT_CODE != REQUEUE_EXIT_CODE
+    # The dispatcher supervises on the SAME codes the runtime exits with.
+    assert dispatch.HANG_EXIT_CODE == HANG_EXIT_CODE
+    assert dispatch.REQUEUE_EXIT_CODE == REQUEUE_EXIT_CODE
+
+
+def test_deadline_model_floor_factor_and_compile_exclusion():
+    wd = DispatchWatchdog(min_deadline_s=10.0, factor=4.0, exit_fn=lambda c: None)
+    try:
+        assert wd.deadline_s() == 10.0  # no samples: the floor
+        wd.observe(300.0)  # the compile-bearing first sample: DROPPED
+        assert wd.deadline_s() == 10.0
+        for _ in range(20):
+            wd.observe(1.0)
+        assert wd.deadline_s() == 10.0  # 4 x p95(1.0) < floor
+        for _ in range(100):
+            wd.observe(5.0)
+        assert wd.deadline_s() == 20.0  # 4 x p95(5.0)
+    finally:
+        wd.close()
+
+
+def test_clean_dispatch_never_fires_and_feeds_distribution():
+    fired = []
+    wd = DispatchWatchdog(
+        min_deadline_s=30.0, factor=50.0, exit_fn=fired.append
+    )
+    try:
+        with wd.armed(1):
+            pass  # compile-bearing first window: sample dropped
+        with wd.armed(2):
+            time.sleep(0.05)
+        assert not fired
+        assert not wd.fired
+        assert wd.deadline_s() == 30.0  # 50 x ~0.05s < floor
+    finally:
+        wd.close()
+
+
+def test_expiry_dumps_stacks_emits_hang_event_and_exits(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    log = telemetry_events.EventLog(log_path)
+    prev = telemetry_events.install(log)
+    exits, diags = [], []
+    release = threading.Event()
+
+    def fake_exit(code):
+        exits.append(code)
+        release.set()  # unwedge the "dispatch" below
+
+    wd = DispatchWatchdog(
+        min_deadline_s=0.2,
+        factor=2.0,
+        logs_dir=str(tmp_path),
+        on_hang=diags.append,
+        exit_fn=fake_exit,
+    )
+    try:
+        with wd.armed(7):
+            # The wedged dispatch: parks until the watchdog "exits".
+            assert release.wait(timeout=30.0)
+    finally:
+        wd.close()
+        telemetry_events.install(prev)
+
+    assert exits == [HANG_EXIT_CODE]
+    assert wd.fired
+    # The owner's bounded unwind hook ran, with the diagnostics.
+    assert len(diags) == 1 and diags[0]["iter"] == 7
+    # Full thread-stack dump on disk: contains THIS (wedged) thread's
+    # frames — the diagnostic that tells a stuck collective from a wedged
+    # host sync.
+    stack_file = tmp_path / "hang_stacks.txt"
+    assert stack_file.exists()
+    dump = stack_file.read_text()
+    assert "test_expiry_dumps_stacks_emits_hang_event_and_exits" in dump
+    assert "iteration 7" in dump
+    # The hang telemetry event carries the exit code + a stack excerpt.
+    log.flush()
+    import json
+
+    events = [
+        json.loads(line) for line in open(log_path) if line.strip()
+    ]
+    hangs = [e for e in events if e["type"] == "hang"]
+    assert len(hangs) == 1
+    assert hangs[0]["exit_code"] == HANG_EXIT_CODE
+    assert hangs[0]["iter"] == 7
+    assert hangs[0]["stacks"]
+
+
+def test_broken_unwind_hook_cannot_block_the_exit(tmp_path):
+    exits = []
+    release = threading.Event()
+
+    def bad_hook(diag):
+        raise RuntimeError("unwind hook is itself broken")
+
+    def fake_exit(code):
+        exits.append(code)
+        release.set()
+
+    wd = DispatchWatchdog(
+        min_deadline_s=0.2, factor=2.0, on_hang=bad_hook, exit_fn=fake_exit
+    )
+    try:
+        with wd.armed(1):
+            assert release.wait(timeout=30.0)
+    finally:
+        wd.close()
+    assert exits == [HANG_EXIT_CODE]
+
+
+def test_close_joins_monitor_thread():
+    before = {t.ident for t in threading.enumerate()}
+    wd = DispatchWatchdog(min_deadline_s=60.0, exit_fn=lambda c: None)
+    spawned = [
+        t for t in threading.enumerate()
+        if t.ident not in before and t.name == "dispatch-watchdog"
+    ]
+    assert len(spawned) == 1
+    wd.close()
+    wd.close()  # idempotent
+    assert not spawned[0].is_alive()
+
+
+def test_dump_all_stacks_includes_every_live_thread():
+    gate = threading.Event()
+    done = threading.Event()
+
+    def parked():
+        done.set()
+        gate.wait(timeout=30.0)
+
+    t = threading.Thread(target=parked, name="parked-for-dump")
+    t.start()
+    try:
+        assert done.wait(timeout=5.0)
+        dump = dump_all_stacks()
+        assert "parked-for-dump" in dump
+        assert "gate.wait" in dump
+    finally:
+        gate.set()
+        t.join(timeout=5.0)

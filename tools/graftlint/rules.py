@@ -596,6 +596,112 @@ class TracedMutationRule(Rule):
         return None
 
 
+class ThreadLifecycleRule(Rule):
+    id = "thread-lifecycle"
+    summary = (
+        "a retained threading.Thread is spawned with no join path "
+        "reachable from its owner — shutdown/rollback/exit paths leak "
+        "the thread (and whatever it pins)"
+    )
+
+    # Attribute tails whose .join() is NOT a thread join.
+    NON_THREAD_JOIN_PREFIXES = ("os.path.", "posixpath.", "ntpath.", "str.")
+
+    def _is_thread_ctor(self, call: ast.Call, module: ModuleFile) -> bool:
+        resolved = resolve_dotted(call.func, module.aliases)
+        return resolved in ("threading.Thread", "Thread") or (
+            bool(resolved) and resolved.endswith(".Thread")
+            and "threading" in resolved
+        )
+
+    def _is_thread_join(self, call: ast.Call, module: ModuleFile) -> bool:
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "join"):
+            return False
+        # ", ".join(parts) — a string-literal base is never a thread.
+        if isinstance(func.value, ast.Constant):
+            return False
+        resolved = resolve_dotted(func, module.aliases) or ""
+        return not resolved.startswith(self.NON_THREAD_JOIN_PREFIXES)
+
+    @staticmethod
+    def _retained(call: ast.Call, parents: dict) -> bool:
+        """Whether the ctor's result is stored somewhere a later join
+        could reach (assignment / comprehension / collection). A pure
+        fire-and-forget expression (``Thread(...).start()``) has no
+        joinable handle — flagging it would only force pointless
+        renames, so it is out of scope."""
+        node = call
+        while node is not None:
+            parent = parents.get(id(node))
+            if isinstance(parent, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                   ast.NamedExpr, ast.Return)):
+                return True
+            if isinstance(parent, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp, ast.List, ast.Tuple,
+                                   ast.Dict, ast.keyword)):
+                return True
+            if isinstance(parent, ast.Expr):
+                return False
+            node = parent
+        return False
+
+    def check(self, module, project):
+        parents: dict[int, ast.AST] = {}
+        enclosing_class: dict[int, ast.ClassDef | None] = {}
+
+        def walk(node, cls):
+            if isinstance(node, ast.ClassDef):
+                cls = node
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+                enclosing_class[id(child)] = cls
+                walk(child, cls)
+
+        walk(module.tree, None)
+
+        spawns = []
+        module_has_join = False
+        class_joins: set[int] = set()  # ids of classes with a join method
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_thread_ctor(node, module):
+                if self._retained(node, parents):
+                    spawns.append(node)
+            elif self._is_thread_join(node, module):
+                module_has_join = True
+                cls = enclosing_class.get(id(node))
+                if cls is not None:
+                    class_joins.add(id(cls))
+
+        for spawn in spawns:
+            cls = enclosing_class.get(id(spawn))
+            if cls is not None:
+                # Spawned by a class: the join must live in a method of
+                # that same class (the owner's close/shutdown path) — a
+                # join elsewhere in the module cannot reach this
+                # instance's thread handle.
+                if id(cls) in class_joins:
+                    continue
+                yield self._v(
+                    module,
+                    spawn,
+                    f"class {cls.name!r} spawns a threading.Thread but no "
+                    "method of it ever joins one — register a close/"
+                    "shutdown path that joins the thread (see "
+                    "DevicePrefetcher.close / DispatchWatchdog.close)",
+                )
+            elif not module_has_join:
+                yield self._v(
+                    module,
+                    spawn,
+                    "module spawns a retained threading.Thread but never "
+                    "joins any thread — the owner's shutdown path cannot "
+                    "reclaim it",
+                )
+
+
 ALL_RULES: list[Rule] = [
     PRNGReuseRule(),
     HostNumpyInTraceRule(),
@@ -605,4 +711,5 @@ ALL_RULES: list[Rule] = [
     DeadFlagRule(),
     DeviceOpInDataPathRule(),
     TracedMutationRule(),
+    ThreadLifecycleRule(),
 ]

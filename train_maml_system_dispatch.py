@@ -1,7 +1,8 @@
 """Internal helper for sequential golden runs (not part of the public CLI).
 
 Runs a config's entry point in phases until the final top-5-ensemble test
-eval has been produced. Two uses:
+eval has been produced, supervising the training process like
+``serve/pool.py`` supervises replicas. Uses:
 
 * classic pause/resume: the train phase exits via sys.exit after
   ``total_epochs_before_pause`` epochs (reference semantics,
@@ -14,6 +15,28 @@ eval has been produced. Two uses:
   runs. Restarting the process every N epochs caps RSS at ~N epochs' leak;
   checkpoint+resume is exact (seed fast-forward, tested), so segmented
   training is bit-identical to a single process.
+* preemption requeue (exit 75): an emergency checkpoint was written, the
+  phase re-enters on the SAME mesh — its own generous budget
+  (``--max_requeues``), separate from the phase budget.
+* hang requeue-degraded (exit 76, ``utils/watchdog.py``): the watchdog
+  detected a wedged dispatch and left a thread-stack diagnostic. The
+  topology itself is suspect, so the next phase resumes the SAME
+  experiment on the next-smaller viable mesh (8 -> 4 -> 2 -> 1 dp,
+  honoring the global meta-batch and ``--task_chunk`` divisibility —
+  ``parallel/mesh.degraded_dp_extent``), riding the mesh-portable
+  checkpoint restore. Hangs draw on their OWN budget (``--max_hangs``):
+  a hang-looping run must not eat the preemption budget, and vice versa.
+  Repeated signal-deaths (two in a row — a crashing device looks like a
+  dying worker, not a preemption) degrade the same way. After a phase
+  completes cleanly on a degraded mesh, a RE-PROMOTION PROBE restores the
+  next-larger extent for the following phase — transient topology faults
+  heal; a re-hang simply degrades again, on budget. Every degrade/promote
+  appends an audit row to ``<experiment>/logs/interruptions.csv``.
+
+``MAML_FAULTS`` (utils/faultinject.py) is consumed by the FIRST phase only:
+env fault plans are one-shot per dispatcher run, so a requeued/degraded
+phase replays clean instead of deterministically re-hitting the same
+injected fault every restart.
 
 Progress is tracked via the experiment's ``logs/summary_statistics.csv`` row
 count; a phase that makes no progress twice in a row aborts (rc of that
@@ -23,23 +46,97 @@ import json
 import os
 import subprocess
 import sys
+import time
+
+#: Preemption requeue (experiment_builder.REQUEUE_EXIT_CODE): emergency
+#: checkpoint written, resume on the SAME mesh.
+REQUEUE_EXIT_CODE = 75
+#: Watchdog hang (utils/watchdog.HANG_EXIT_CODE): requeue but SUSPECT THE
+#: TOPOLOGY — resume on the next-smaller viable mesh.
+HANG_EXIT_CODE = 76
+
+#: Test hook: overrides which entry script a phase runs (the budget/degrade
+#: policy is provable without compiling real XLA programs). Internal.
+ENTRY_ENV = "MAML_DISPATCH_ENTRY"
+
+
+def _pop_flag(extra, name, default, cast):
+    if name in extra:
+        i = extra.index(name)
+        value = cast(extra[i + 1])
+        del extra[i:i + 2]
+        return value
+    return default
+
+
+def _audit_row(exp_name: str, kind: str) -> None:
+    """Appends a dispatcher audit row to the experiment's interruptions
+    CSV (same 4-column header the builder's preemption rows use, so one
+    file holds the full interruption history)."""
+    logs = os.path.join(exp_name, "logs")
+    try:
+        os.makedirs(logs, exist_ok=True)
+        path = os.path.join(logs, "interruptions.csv")
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("timestamp,signal,current_iter,epoch\n")
+        with open(path, "a") as f:
+            f.write(f"{time.time()},{kind},,\n")
+    except OSError:
+        pass  # auditing must not break supervision
+
+
+def _resolved_dp(cfg_dict: dict, extra: list) -> int:
+    """The dp extent the next phase will actually run: an explicit config/
+    CLI value, else (lazily, only when a degrade decision needs it) the
+    local-device fill the mesh builder would compute."""
+    dp = int(cfg_dict.get("data_parallel_devices", 0) or 0)
+    if dp <= 0 and "--data_parallel_devices" in extra:
+        dp = int(extra[extra.index("--data_parallel_devices") + 1])
+    if dp > 0:
+        return dp
+    import jax  # deliberate lazy import: only the degrade path pays it
+
+    mp = int(cfg_dict.get("model_parallel_devices", 1) or 1)
+    return max(len(jax.devices()) // max(mp, 1), 1)
+
+
+def _next_smaller_dp(cfg_dict: dict, current_dp: int) -> int | None:
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import degraded_dp_extent
+
+    global_batch = (
+        int(cfg_dict.get("num_of_gpus", 1) or 1)
+        * int(cfg_dict.get("batch_size", 32))
+        * int(cfg_dict.get("samples_per_iter", 1) or 1)
+    )
+    return degraded_dp_extent(
+        current_dp,
+        global_batch=global_batch,
+        task_chunk=int(cfg_dict.get("task_chunk", 0) or 0),
+    )
 
 
 def main() -> int:
     argv = sys.argv[1:]
     cfg = argv[0]
-    extra = argv[1:]
-    pause_every = None
-    if "--pause_every" in extra:
-        i = extra.index("--pause_every")
-        pause_every = int(extra[i + 1])
-        extra = extra[:i] + extra[i + 2 :]
-        if pause_every < 1:
-            raise SystemExit(f"--pause_every must be >= 1, got {pause_every}")
+    extra = list(argv[1:])
+    pause_every = _pop_flag(extra, "--pause_every", None, int)
+    if pause_every is not None and pause_every < 1:
+        raise SystemExit(f"--pause_every must be >= 1, got {pause_every}")
+    # Requeue exits (rc 75) are preemption-safe: an emergency checkpoint
+    # was written mid-epoch, so re-entering is always progress even though
+    # no epoch row landed. They get their own (generous) budget instead of
+    # consuming the phase budget — a heavily-preempted long run must not
+    # abort as "budget exhausted" while advancing monotonically. Hang
+    # exits (rc 76) get a SEPARATE budget for the same reason in reverse:
+    # the two failure classes must not starve each other's recovery.
+    max_requeues = _pop_flag(extra, "--max_requeues", 100, int)
+    max_hangs = _pop_flag(extra, "--max_hangs", 8, int)
 
-    entry = ("train_gradient_descent_system.py" if "gradient-descent" in cfg
-             else "train_matching_nets_system.py" if "matching-nets" in cfg
-             else "train_maml_system.py")
+    entry = os.environ.get(ENTRY_ENV) or (
+        "train_gradient_descent_system.py" if "gradient-descent" in cfg
+        else "train_matching_nets_system.py" if "matching-nets" in cfg
+        else "train_maml_system.py")
     # Canonical configs live in experiment_config/ (the reference's 38-file
     # surface, content-tested); local variants (bf16, resnet12, ...) in
     # experiment_config_local/ so regeneration identity stays intact.
@@ -72,48 +169,103 @@ def main() -> int:
               "nothing to run", flush=True)
         return 0
 
-    patched_path = None
+    # Config-key overrides are written into a patched config file rather
+    # than passed as flags: the JSON wins over every flag except
+    # continue_from/gpu_to_use (reference semantics, utils/parser_utils.py),
+    # so a flag could be silently overridden by the config. experiment_name
+    # is unchanged so logs, checkpoints and resume behave identically.
+    overrides: dict = {}
     if pause_every is not None:
-        # A --total_epochs_before_pause CLI flag would be OVERRIDDEN by the
-        # config JSON (JSON wins over every flag except continue_from/
-        # gpu_to_use — reference semantics, utils/parser_utils.py). Write a
-        # patched config instead; experiment_name is unchanged so logs,
-        # checkpoints and resume behave identically.
+        overrides["total_epochs_before_pause"] = pause_every
+    patched_path = None
+    run_cfg_path = cfg_path
+
+    def write_patched():
+        nonlocal patched_path, run_cfg_path
         import tempfile
 
-        cfg_dict["total_epochs_before_pause"] = pause_every
+        if patched_path is not None:
+            try:
+                os.unlink(patched_path)
+            except OSError:
+                pass
+            patched_path = None
+        if not overrides:
+            run_cfg_path = cfg_path
+            return
         patched = tempfile.NamedTemporaryFile(
             "w", suffix=f"_{cfg}.json", delete=False
         )
-        json.dump(cfg_dict, patched)
+        json.dump({**cfg_dict, **overrides}, patched)
         patched.close()
-        cfg_path = patched_path = patched.name
+        run_cfg_path = patched_path = patched.name
+
+    write_patched()
+
+    # Degraded-mesh state: dp extents we stepped down from, newest last —
+    # popped one level at each re-promotion probe.
+    promote_stack: list[int] = []
 
     try:
         max_phases = 2 * (total_epochs // (pause_every or total_epochs) + 2)
-        # Requeue exits (rc 75, experiment_builder.REQUEUE_EXIT_CODE) are
-        # preemption-safe: an emergency checkpoint was written mid-epoch, so
-        # re-entering is always progress even though no epoch row landed.
-        # They get their own (generous) budget instead of consuming the
-        # phase budget — a heavily-preempted long run must not abort as
-        # "budget exhausted" while advancing monotonically.
-        max_requeues = 100
-        stalled = phase = requeues = 0
+        stalled = phase = requeues = hangs = signal_deaths = 0
+        child_env = dict(os.environ)
         rc = 0
-        while phase < max_phases and requeues < max_requeues:
+        while (
+            phase < max_phases
+            and requeues < max_requeues
+            and hangs < max_hangs
+        ):
             before = epochs_logged()
             print(f"--- {cfg}: phase {phase} via {entry} "
                   f"(epochs logged: {before}/{total_epochs})", flush=True)
             proc = subprocess.run(
                 [sys.executable, "-u", entry, "--name_of_args_json_file",
-                 cfg_path, *extra], check=False,
+                 run_cfg_path, *extra], check=False, env=child_env,
             )
             rc = proc.returncode
+            # Env fault plans are one-shot per dispatcher run: the phase
+            # that just ran consumed them; a requeued/degraded phase must
+            # replay clean, not re-hit the same injected fault forever.
+            child_env.pop("MAML_FAULTS", None)
             if os.path.exists(test_csv):
                 break
-            if rc == 75:
-                stalled = 0
+            if rc == REQUEUE_EXIT_CODE:
+                stalled = signal_deaths = 0
                 requeues += 1
+                continue
+            died_by_signal = rc < 0 or rc > 128
+            signal_deaths = signal_deaths + 1 if died_by_signal else 0
+            if rc == HANG_EXIT_CODE or signal_deaths >= 2:
+                # Suspect the topology: a wedged dispatch (watchdog
+                # diagnostic in logs/hang_stacks.txt) or a device that
+                # keeps killing its worker. Resume the same experiment on
+                # the next-smaller viable mesh, from the last valid
+                # checkpoint (mesh-portable restore).
+                hangs += 1
+                stalled = signal_deaths = 0
+                current_dp = _resolved_dp(
+                    {**cfg_dict, **overrides}, extra
+                )
+                smaller = _next_smaller_dp(cfg_dict, current_dp)
+                why = ("hang" if rc == HANG_EXIT_CODE
+                       else "repeated-signal-death")
+                if smaller is not None:
+                    promote_stack.append(current_dp)
+                    overrides["data_parallel_devices"] = smaller
+                    write_patched()
+                    _audit_row(
+                        exp_name,
+                        f"{why}-degrade:dp{current_dp}->dp{smaller}",
+                    )
+                    print(f"--- {cfg}: {why} (rc {rc}); degrading mesh "
+                          f"dp{current_dp} -> dp{smaller} and resuming "
+                          "from the last valid checkpoint", flush=True)
+                else:
+                    _audit_row(exp_name, f"{why}-requeue:dp{current_dp}")
+                    print(f"--- {cfg}: {why} (rc {rc}) with no smaller "
+                          "viable mesh; requeueing on the same topology",
+                          flush=True)
                 continue
             phase += 1
             if epochs_logged() <= before:
@@ -124,6 +276,20 @@ def main() -> int:
                     return rc or 1
             else:
                 stalled = 0
+                if promote_stack:
+                    # Re-promotion probe: the degraded mesh just completed
+                    # a phase with real progress — try one step back up;
+                    # a re-hang degrades again, on budget.
+                    restored = promote_stack.pop()
+                    overrides["data_parallel_devices"] = restored
+                    write_patched()
+                    _audit_row(exp_name, f"probe-promote:dp{restored}")
+                    print(f"--- {cfg}: clean degraded phase; probing "
+                          f"re-promotion to dp{restored}", flush=True)
+        if hangs >= max_hangs:
+            print(f"--- {cfg}: hang budget ({max_hangs}) exhausted, "
+                  "aborting", flush=True)
+            return rc or 1
         if not os.path.exists(test_csv):
             print(f"--- {cfg}: phase budget exhausted without test eval",
                   flush=True)
