@@ -124,6 +124,7 @@ class DevicePrefetcher:
         epoch_len: int | None = None,
         sharding=None,
         fault_budget: int = 0,
+        put=None,
     ):
         if group < 1:
             raise ValueError(f"group must be >= 1, got {group}")
@@ -138,6 +139,14 @@ class DevicePrefetcher:
         # critical path — why PR 7 disabled staging on mesh runs). None =
         # single-device put, the PR 7 behavior.
         self._sharding = sharding
+        # Multi-host staging override: a callable ``arrays -> staged
+        # arrays`` replacing the device_put entirely. On multi-host meshes
+        # no single process can device_put a global batch (the sharding
+        # spans non-addressable devices); the builder passes
+        # ``parallel.multihost.process_local_put`` — each host stages its
+        # OWN loader shard and receives the assembled global array view,
+        # keeping the overlapped pipeline per host.
+        self._put = put
         self._auto = depth == AUTO_DEPTH
         self._capacity = DEFAULT_DEPTH if self._auto else int(depth)
         if self._capacity < 1:
@@ -209,11 +218,12 @@ class DevicePrefetcher:
                 np.stack([p[i] for p in prepared])
                 for i in range(len(prepared[0]))
             )
-        staged = (
-            jax.device_put(arrays)
-            if self._sharding is None
-            else jax.device_put(arrays, self._sharding)
-        )
+        if self._put is not None:
+            staged = self._put(arrays)
+        elif self._sharding is None:
+            staged = jax.device_put(arrays)
+        else:
+            staged = jax.device_put(arrays, self._sharding)
         return StagedBatch(
             arrays=staged,
             n_iters=len(samples),

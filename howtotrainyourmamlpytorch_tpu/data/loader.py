@@ -58,14 +58,17 @@ def _collate_episodes(episodes):
 _FORK_DATASET: FewShotLearningDataset | None = None
 
 
-def _synthesize_batch_in_worker(set_name, seed_base, augment, b, global_batch):
-    """One collated batch, synthesized inside a forked worker process.
-    Episode parameters are explicit (snapshot semantics identical to the
-    thread backend); only the collated arrays cross the process boundary."""
+def _synthesize_batch_in_worker(set_name, seed_base, augment, b, global_batch,
+                                shard_lo, shard_size):
+    """One collated batch (this process's shard of it), synthesized inside
+    a forked worker process. Episode parameters are explicit (snapshot
+    semantics identical to the thread backend); only the collated arrays
+    cross the process boundary."""
     ds = _FORK_DATASET
+    base = b * global_batch + shard_lo
     return _collate_episodes([
         ds.get_set(set_name, seed=seed_base + idx, augment_images=augment)
-        for idx in range(b * global_batch, (b + 1) * global_batch)
+        for idx in range(base, base + shard_size)
     ])
 
 
@@ -83,6 +86,20 @@ class MetaLearningSystemDataLoader:
         self.batch_size = args.batch_size
         self.samples_per_iter = args.samples_per_iter
         self.num_workers = max(int(args.num_dataprovider_workers), 1)
+        # Per-host data plane (multi-host meshes): this loader synthesizes
+        # only the ``[shard_lo, shard_lo + shard_size)`` slice of every
+        # global batch's episode indices. Seeds stay GLOBAL-INDEX keyed
+        # (``seed_base + global_episode_index``), so the batch assembled
+        # across hosts is bit-identical to a single-process loader at any
+        # shard count — determinism is a property of the episode index,
+        # not of who synthesizes it. Defaults (0 of 1) are the whole batch.
+        self.shard_index = int(getattr(args, "data_shard_index", 0) or 0)
+        self.shard_count = max(int(getattr(args, "data_shard_count", 1) or 1), 1)
+        if not 0 <= self.shard_index < self.shard_count:
+            raise ValueError(
+                f"data_shard_index {self.shard_index} out of range for "
+                f"{self.shard_count} shard(s)"
+            )
         self.total_train_iters_produced = 0
         self.dataset = FewShotLearningDataset(args=args)
         self.batches_per_iter = args.samples_per_iter
@@ -129,8 +146,26 @@ class MetaLearningSystemDataLoader:
 
     @property
     def global_batch(self) -> int:
-        """Episodes consumed per yielded batch (``data.py:575-581``)."""
+        """Episodes consumed per yielded batch (``data.py:575-581``) —
+        the GLOBAL count: seed windows and epoch math stay host-count
+        independent; a sharded loader yields ``shard_size`` of them."""
         return self.num_of_gpus * self.batch_size * self.samples_per_iter
+
+    @property
+    def shard_size(self) -> int:
+        """Episodes THIS loader synthesizes per batch (its host's slice)."""
+        if self.global_batch % self.shard_count != 0:
+            raise ValueError(
+                f"global meta-batch {self.global_batch} not divisible by "
+                f"{self.shard_count} data-plane shard(s)"
+            )
+        return self.global_batch // self.shard_count
+
+    @property
+    def shard_lo(self) -> int:
+        """First global episode index (within a batch) of this shard —
+        the contiguous ``parallel/mesh.host_batch_bounds`` slice."""
+        return self.shard_index * self.shard_size
 
     def continue_from_iter(self, current_iter: int) -> None:
         """Fast-forwards the train seed offset after resume (``data.py:
@@ -171,6 +206,7 @@ class MetaLearningSystemDataLoader:
         unaugmented val-split episode, silently training on (and massively
         overfitting) the 50-class val split."""
         n_batches = length // self.global_batch
+        shard_lo, shard_size = self.shard_lo, self.shard_size
         out: queue.Queue = queue.Queue(maxsize=prefetch)
         sentinel = object()
 
@@ -179,19 +215,20 @@ class MetaLearningSystemDataLoader:
                 return self._pool.submit(
                     _synthesize_batch_in_worker,
                     set_name, seed_base, augment, b, self.global_batch,
+                    shard_lo, shard_size,
                 )
         else:
             def synthesize_batch(b: int):
-                """One collated batch, synthesized serially by one worker
-                thread. Batch-granularity tasks (~3ms) amortize executor/
-                queue overhead that per-episode tasks (~0.4ms) drowned in."""
+                """One collated batch (this host's shard of it), synthesized
+                serially by one worker thread. Batch-granularity tasks
+                (~3ms) amortize executor/queue overhead that per-episode
+                tasks (~0.4ms) drowned in."""
+                base = b * self.global_batch + shard_lo
                 return _collate_episodes([
                     self.dataset.get_set(
                         set_name, seed=seed_base + idx, augment_images=augment
                     )
-                    for idx in range(
-                        b * self.global_batch, (b + 1) * self.global_batch
-                    )
+                    for idx in range(base, base + shard_size)
                 ])
 
             def submit(b):

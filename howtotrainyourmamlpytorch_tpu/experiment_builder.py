@@ -216,12 +216,41 @@ class ExperimentBuilder:
             n_devices = int(np.prod(list(axes.values())))
         else:
             n_devices = mesh_dp = mesh_mp = 1
+        # Host identity (multi-host fleets; 0-of-1 single-process —
+        # stamped by get_args after initialize_distributed). Rank 0 is the
+        # CHIEF: the single writer of checkpoints and the summary CSV/JSON
+        # (every rank holds bit-identical replicated state, so electing one
+        # writer loses nothing and prevents same-file write races on a
+        # shared experiment dir). Audit rows and telemetry events stay
+        # per-rank — fault ATTRIBUTION is the point of multi-host
+        # observability.
+        self.process_index = int(getattr(args, "process_index", 0) or 0)
+        self.process_count = max(
+            int(getattr(args, "process_count", 1) or 1), 1
+        )
+        self._is_chief = self.process_index == 0
+        self._multihost = self.process_count > 1
+        if self._multihost:
+            sharding_for = getattr(model, "staged_batch_sharding", None)
+            if (
+                mesh is None
+                or sharding_for is None
+                or sharding_for(1) is None
+            ):
+                raise ValueError(
+                    "multi-host training requires a learner that declares "
+                    "a dp batch sharding for its step programs (MAML's dp "
+                    "path); this learner/mesh combination cannot span "
+                    f"{self.process_count} processes"
+                )
         self.telemetry = TrainTelemetry(
             self.logs_filepath,
             enabled=bool(getattr(args, "telemetry", True)),
             n_devices=n_devices,
             mesh_dp=mesh_dp,
             mesh_mp=mesh_mp,
+            process_index=self.process_index,
+            process_count=self.process_count,
             profile_trace_path=str(
                 getattr(args, "profile_trace_path", "") or ""
             ),
@@ -264,6 +293,7 @@ class ExperimentBuilder:
         self._watchdog: DispatchWatchdog | None = None
         self._ckpt_writer: AsyncCheckpointWriter | None = None
         self._last_ckpt_t = time.monotonic()
+        self._epoch_boundaries_done = 0
 
     # ------------------------------------------------------------------
     # Metric summarization (experiment_builder.py:65-100)
@@ -418,33 +448,80 @@ class ExperimentBuilder:
         the pending shutdown signal number; the watchdog passes ``"hang"``
         (and the dispatcher appends its own degrade/promote rows to the
         same file), so the full interruption history of an experiment
-        reads from one place."""
+        reads from one place. EVERY rank writes its own rows — the
+        process_index/process_count columns are what attribute a
+        multi-host fault to the rank that saw it. Rows align to the file's
+        existing header, so resuming a pre-multi-host experiment appends
+        4-column rows instead of silently shifting columns."""
         interruptions = os.path.join(self.logs_filepath, "interruptions.csv")
+        header = [
+            "timestamp", "signal", "current_iter", "epoch",
+            "process_index", "process_count",
+        ]
         if not os.path.exists(interruptions):
-            save_statistics(
-                self.logs_filepath,
-                ["timestamp", "signal", "current_iter", "epoch"],
-                filename="interruptions.csv",
-                create=True,
-            )
+            # O_EXCL create: on a fleet-wide preemption every rank writes
+            # its audit row within milliseconds — a mode-'w' create here
+            # would let one rank's truncate-create erase another's
+            # header+row (the rows the chaos verdict and
+            # multihost_recovery_s are computed from). Exactly one rank
+            # wins the header; the rest fall through to the append.
+            try:
+                fd = os.open(
+                    interruptions, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                )
+                os.write(fd, (",".join(header) + "\n").encode())
+                os.close(fd)
+            except FileExistsError:
+                pass
+        row = [time.time(),
+               int(self._shutdown_signum) if kind is None else kind,
+               int(self.state["current_iter"]), self.epoch,
+               self.process_index, self.process_count]
+        try:
+            with open(interruptions) as f:
+                existing = f.readline().rstrip("\n").split(",")
+            if len(existing) < len(header):
+                row = row[: len(existing)]
+        except OSError:
+            pass
         save_statistics(
-            self.logs_filepath,
-            [time.time(),
-             int(self._shutdown_signum) if kind is None else kind,
-             int(self.state["current_iter"]), self.epoch],
-            filename="interruptions.csv",
+            self.logs_filepath, row, filename="interruptions.csv",
         )
 
     # ------------------------------------------------------------------
     # Dispatch hang watchdog (utils/watchdog.py)
     # ------------------------------------------------------------------
 
-    def _armed(self, upto_iter: int):
+    def _armed(self, upto_iter: int, observe: bool = True,
+               scale: float = 1.0):
         """Watchdog-armed window for one device dispatch (no-op context
-        when the watchdog is disabled or not yet running)."""
+        when the watchdog is disabled or not yet running).
+        ``observe=False`` = a non-dispatch forced-read window (epoch
+        boundary): covered by the deadline, excluded from the step-time
+        distribution that derives it. ``scale`` stretches the deadline
+        for windows whose legitimate duration is a multiple of a
+        dispatch (the boundary holds a whole validation epoch)."""
         if self._watchdog is None:
             return contextlib.nullcontext()
-        return self._watchdog.armed(upto_iter)
+        return self._watchdog.armed(upto_iter, observe=observe, scale=scale)
+
+    def _multihost_barrier(self, tag: str) -> None:
+        if self._multihost:
+            from .parallel.multihost import barrier
+
+            barrier(tag)
+
+    def _boundary_deadline_scale(self) -> float:
+        """Deadline multiple for the watchdog-armed epoch boundary: its
+        healthy duration is ~one summary sync + a full validation epoch +
+        a checkpoint snapshot, so the per-dispatch-derived deadline is
+        stretched by the val-batch count (+ slack) — a long-but-healthy
+        val epoch must not read as a hang, while a genuinely wedged
+        boundary still trips in bounded time."""
+        num_val_batches = max(
+            int(self.args.num_evaluation_tasks / self.args.batch_size), 1
+        )
+        return float(num_val_batches + 4)
 
     def _on_hang(self, diag: dict) -> None:
         """Bounded graceful unwind, called from the watchdog's monitor
@@ -547,7 +624,7 @@ class ExperimentBuilder:
                 "handles the replay",
                 file=sys.stderr,
             )
-        else:
+        elif self._is_chief:
             self.model.save_model(path, self.train_state, self.state)
         self._write_interruption_row()
         print(
@@ -638,6 +715,12 @@ class ExperimentBuilder:
         # may BE the newest valid state; its submit preceded the trip).
         if self._ckpt_writer is not None:
             self._ckpt_writer.drain()
+        # Multi-host: every rank trips the sentinel identically (the
+        # metrics are replicated), but only the chief's drain fences a
+        # real write — barrier before anyone reloads, or a non-chief rank
+        # could read the STALE 'latest' the chief is mid-replace and
+        # silently desynchronize the replicated state.
+        self._multihost_barrier("pre-rollback-reload")
         self._rollbacks_this_run += 1
         if self._rollbacks_this_run > MAX_ROLLBACKS_PER_RUN:
             raise NonFiniteLossError(
@@ -805,9 +888,28 @@ class ExperimentBuilder:
                 self.telemetry.boundary(current_iter, sync_s, reason="log")
         return total_losses, current_iter
 
+    def _stage_eval_batch(self, data_batch):
+        """Multi-host eval staging: the loader yielded THIS host's shard
+        of the episode batch; prepare it (wire codec) and assemble the
+        global device arrays the eval program's dp ``in_shardings``
+        expect. Identity single-process (the learner preps inline)."""
+        if not self._multihost:
+            return data_batch
+        from .parallel.multihost import process_local_put
+
+        codec = getattr(self.model.cfg, "wire_codec", None)
+        put = process_local_put(self.model.staged_batch_sharding(1))
+        return StagedBatch(
+            arrays=put(prepare_batch(data_batch, codec=codec)),
+            n_iters=1,
+            first_iter=0,
+        )
+
     def evaluation_iteration(self, val_sample, total_losses, phase):
         x_support, x_target, y_support, y_target, _seed = val_sample
-        data_batch = (x_support, x_target, y_support, y_target)
+        data_batch = self._stage_eval_batch(
+            (x_support, x_target, y_support, y_target)
+        )
         self.train_state, losses, _preds = self.model.run_validation_iter(
             self.train_state, data_batch
         )
@@ -818,13 +920,24 @@ class ExperimentBuilder:
     def test_evaluation_iteration(self, val_sample, model_idx,
                                   per_model_per_batch_preds):
         x_support, x_target, y_support, y_target, _seed = val_sample
-        data_batch = (x_support, x_target, y_support, y_target)
+        data_batch = self._stage_eval_batch(
+            (x_support, x_target, y_support, y_target)
+        )
         self.train_state, _losses, per_task_preds = self.model.run_validation_iter(
             self.train_state, data_batch
         )
         # Convert once per batch: the ensemble holds every model's full
-        # test-set logits, which must not accumulate in device memory.
-        per_model_per_batch_preds[model_idx].extend(list(np.asarray(per_task_preds)))
+        # test-set logits, which must not accumulate in device memory. On
+        # multi-host meshes the logits are task-sharded across hosts —
+        # gather the GLOBAL predictions (one allgather) so every rank
+        # scores the full test set identically.
+        if self._multihost:
+            from .parallel.multihost import gather_global
+
+            preds_host = gather_global(per_task_preds)
+        else:
+            preds_host = np.asarray(per_task_preds)
+        per_model_per_batch_preds[model_idx].extend(list(preds_host))
         return per_model_per_batch_preds
 
     # ------------------------------------------------------------------
@@ -847,6 +960,12 @@ class ExperimentBuilder:
         # submit/drain boundary with the same typed error.
         epoch_path = self._checkpoint_path(int(epoch))
         latest = self._checkpoint_path("latest")
+        if not self._is_chief:
+            # Multi-host: every rank holds bit-identical replicated state;
+            # rank 0 is the elected checkpoint writer (two ranks racing
+            # the same tmp+rename on a shared dir corrupt each other).
+            self._last_ckpt_t = time.monotonic()
+            return
         t0 = time.perf_counter()
         if self._ckpt_writer is not None and hasattr(model, "snapshot_model"):
             snapshot = model.snapshot_model(self.train_state, state)
@@ -876,15 +995,22 @@ class ExperimentBuilder:
         epoch_summary_losses["epoch"] = self.epoch
         epoch_summary_losses["epoch_run_time"] = time.time() - start_time
 
-        if create_summary_csv:
+        if create_summary_csv and self._is_chief:
             self.summary_statistics_filepath = save_statistics(
                 self.logs_filepath, list(epoch_summary_losses.keys()), create=True
             )
+        if create_summary_csv:
             self.create_summary_csv = False
 
         start_time = time.time()
         print("epoch {} -> {}".format(epoch_summary_losses["epoch"],
                                       epoch_summary_string))
+        if not self._is_chief:
+            # Multi-host: per-epoch statistics stay maintained on every
+            # rank (best-val tracking and the ensemble selection must be
+            # identical everywhere), but only the chief writes the shared
+            # summary CSV — the supervisor's progress signal.
+            return start_time, state
         # Rows are positional: when resuming an experiment whose CSV was
         # created by an older build (different metric-key set, e.g. without
         # train_nonfinite_trips), align the row to the FILE's header —
@@ -937,7 +1063,15 @@ class ExperimentBuilder:
                 # write (state holds a RELOADED ensemble model), just a
                 # prompt requeue exit — the phase re-runs in full.
                 self._maybe_emergency_exit(write_checkpoint=False)
-                per_model_per_batch_targets[idx].extend(np.array(test_sample[3]))
+                targets = np.array(test_sample[3])
+                if self._multihost:
+                    # The loader yielded this host's shard of the episode
+                    # batch; score against the GLOBAL targets, matching
+                    # the allgathered predictions.
+                    from .parallel.multihost import allgather_host
+
+                    targets = allgather_host(targets)
+                per_model_per_batch_targets[idx].extend(targets)
                 per_model_per_batch_preds = self.test_evaluation_iteration(
                     val_sample=test_sample,
                     model_idx=idx,
@@ -956,10 +1090,11 @@ class ExperimentBuilder:
             "test_accuracy_std": np.std(correct),
         }
 
-        save_statistics(self.logs_filepath, list(test_losses.keys()),
-                        create=True, filename="test_summary.csv")
-        save_statistics(self.logs_filepath, list(test_losses.values()),
-                        create=False, filename="test_summary.csv")
+        if self._is_chief:
+            save_statistics(self.logs_filepath, list(test_losses.keys()),
+                            create=True, filename="test_summary.csv")
+            save_statistics(self.logs_filepath, list(test_losses.values()),
+                            create=False, filename="test_summary.csv")
         print(test_losses)
         return test_losses
 
@@ -977,6 +1112,10 @@ class ExperimentBuilder:
                 factor=self.watchdog_factor,
                 logs_dir=self.logs_filepath,
                 on_hang=self._on_hang,
+                identity={
+                    "process_index": self.process_index,
+                    "process_count": self.process_count,
+                },
             )
         try:
             # activate(): installs the process-global event sink (so
@@ -1028,6 +1167,11 @@ class ExperimentBuilder:
         # without its epoch).
         if self._ckpt_writer is not None:
             self._ckpt_writer.drain()
+        # Multi-host: the drain above fences only the CHIEF's writer —
+        # the other ranks' writers are empty by construction. Barrier so
+        # no rank can reach load_model before the chief's last
+        # tmp+rename published.
+        self._multihost_barrier("pre-ensemble")
         return self.evaluated_test_set_using_the_best_models(top_n_models=5)
 
     def _make_stager(self, batches) -> "DevicePrefetcher | None":
@@ -1043,7 +1187,8 @@ class ExperimentBuilder:
         declines (``None`` with a mesh — the arg-driven mp layout) keeps
         the inline host loop: a committed staged layout there could force
         a reshard copy onto the critical path."""
-        if self.device_prefetch == 0:
+        multihost = bool(getattr(self, "_multihost", False))
+        if self.device_prefetch == 0 and not multihost:
             return None
         group = self.iters_per_dispatch if self._use_multi else 1
         sharding = None
@@ -1057,6 +1202,18 @@ class ExperimentBuilder:
         def prepare(host_batch):
             return prepare_batch(host_batch, codec=codec)
 
+        # Multi-host: the staged put becomes per-host assembly — each
+        # process stages ITS loader shard and receives the global array
+        # view (jax.make_array_from_process_local_data; no single process
+        # can device_put a sharding spanning non-addressable devices). The
+        # stager is therefore mandatory on multi-host runs: the inline
+        # host loop has no way to build a global batch.
+        put = None
+        if multihost:
+            from .parallel.multihost import process_local_put
+
+            put = process_local_put(sharding)
+
         return DevicePrefetcher(
             batches,
             prepare,
@@ -1068,6 +1225,7 @@ class ExperimentBuilder:
             start_iter=int(self.state["current_iter"]),
             epoch_len=int(self.args.total_iter_per_epoch),
             sharding=sharding,
+            put=put,
             # Transient producer faults (loader I/O blip, one corrupt
             # episode) are retried-then-skipped under this budget instead
             # of killing training at the next pop (--data_fault_budget;
@@ -1171,9 +1329,27 @@ class ExperimentBuilder:
         cadence — then the preemption check — AFTER the epoch block, so a
         signal landing on a boundary dispatch still gets its val epoch +
         epoch checkpoint + stats row before the exit (a mid-epoch
-        emergency resume cannot reconstruct those)."""
+        emergency resume cannot reconstruct those).
+
+        The epoch boundary runs under its own watchdog-armed window
+        (``observe=False`` — its duration must not feed the per-dispatch
+        deadline): its summary sync is the first forced read after a
+        dispatch, which is exactly where a surviving rank wedges when a
+        multi-host peer dies mid-epoch — the watchdog turns that silent
+        wedge into the rc-76 host-loss signal the dispatcher acts on. The
+        FIRST boundary of a process stays unarmed: it carries the
+        eval-step XLA compile, the same cold-start cost the watchdog's
+        first-dispatch exclusion exists for."""
         if self.state["current_iter"] % self.args.total_iter_per_epoch == 0:
-            self._run_epoch_boundary()
+            if self._epoch_boundaries_done >= 1:
+                with self._armed(
+                    self.state["current_iter"], observe=False,
+                    scale=self._boundary_deadline_scale(),
+                ):
+                    self._run_epoch_boundary()
+            else:
+                self._run_epoch_boundary()
+            self._epoch_boundaries_done += 1
         elif (
             self.checkpoint_interval_s > 0
             and time.monotonic() - self._last_ckpt_t
@@ -1208,6 +1384,9 @@ class ExperimentBuilder:
             return
         path = self._checkpoint_path("latest")
         t0 = time.perf_counter()
+        if not self._is_chief:
+            self._last_ckpt_t = time.monotonic()
+            return
         if self._ckpt_writer is not None and hasattr(
             self.model, "snapshot_model"
         ):
@@ -1287,11 +1466,12 @@ class ExperimentBuilder:
                          state=self.state)
         self.total_losses = {}
         self.epochs_done_in_this_run += 1
-        save_to_json(
-            filename=os.path.join(self.logs_filepath,
-                                  "summary_statistics.json"),
-            dict_to_store=self.state["per_epoch_statistics"],
-        )
+        if self._is_chief:
+            save_to_json(
+                filename=os.path.join(self.logs_filepath,
+                                      "summary_statistics.json"),
+                dict_to_store=self.state["per_epoch_statistics"],
+            )
         # Flush the checkpoint-save/alias events the epoch publish
         # just emitted (still a forced-read boundary, zero new
         # syncs).

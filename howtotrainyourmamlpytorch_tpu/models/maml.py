@@ -894,8 +894,15 @@ class MAMLFewShotLearner(CheckpointableLearner):
     def run_validation_iter(self, state: TrainState, data_batch):
         """Evaluation episode batch. Returns ``(state, losses_dict,
         per_task_preds)``; state is returned unchanged (pure eval — the
-        functional form of the reference's BN backup/restore)."""
-        batch = self._prepare_batch(data_batch)
+        functional form of the reference's BN backup/restore).
+        ``data_batch`` may be a :class:`StagedBatch` of already-prepared
+        device arrays (the multi-host builder stages eval batches globally
+        — a host cannot ``np.asarray`` a cross-host array here)."""
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else self._prepare_batch(data_batch)
+        )
         cfg = self.cfg
         # The eval target loss sits at the *training* final-step index
         # (few_shot_learning_system.py:239); when that coincides with the

@@ -14,6 +14,10 @@ from .mesh import (
     make_mesh,
     batch_sharding,
     default_mesh_from_args,
+    degraded_dp_extent,
+    degraded_process_count,
+    host_batch_bounds,
+    multihost_device_order,
     replicated,
     param_shardings,
     DEFAULT_DATA_AXIS,
@@ -30,15 +34,25 @@ from .sharding import (
     state_shardings,
     tree_shardings,
 )
-from .distributed import initialize_distributed
+from .distributed import (
+    DistributedInitError,
+    initialize_distributed,
+    initialize_distributed_from_argv,
+)
 
 __all__ = [
     "make_mesh",
     "default_mesh_from_args",
+    "degraded_dp_extent",
+    "degraded_process_count",
+    "host_batch_bounds",
+    "multihost_device_order",
     "batch_sharding",
     "replicated",
     "param_shardings",
+    "DistributedInitError",
     "initialize_distributed",
+    "initialize_distributed_from_argv",
     "DEFAULT_DATA_AXIS",
     "DEFAULT_MODEL_AXIS",
     "DP_STATE_RULES",

@@ -1,45 +1,261 @@
-"""Multi-host bring-up.
+"""Multi-host bring-up: fail-fast ``jax.distributed`` initialization.
 
 The reference has no multi-node backend at all (no ``torch.distributed``
 anywhere — SURVEY §2). Here, multi-host scale-out is one call: JAX's runtime
 coordinates hosts over DCN and exposes every chip in a single global mesh, so
 the same ``jit``-with-shardings train step spans pods unchanged.
+
+Bring-up is the one phase the PR 10 watchdog cannot cover — it arms around
+dispatches, and a wrong ``--coordinator_address`` blocks INSIDE
+``jax.distributed.initialize`` before the first dispatch exists. Fail-fast
+therefore lives here:
+
+* non-coordinator ranks preflight a TCP probe of the coordinator endpoint
+  (retried until the init timeout — the coordinator may legitimately come up
+  after its workers) and raise a typed :class:`DistributedInitError` with a
+  "coordinator unreachable" message instead of parking forever;
+* the runtime handshake itself runs under ``initialization_timeout`` (JAX's
+  own bring-up deadline), and any failure there is re-raised as the same
+  typed error so supervisors can tell "bring-up failed" from "training
+  crashed".
+
+On CPU backends the cross-process collective implementation is switched to
+gloo before initialization (the default CPU client refuses multi-process
+computations outright), which is what makes the two-process CPU fleet —
+tests, chaos harness, bench receipts — run the REAL multi-host code path.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import socket
+import time
 
-import jax
+#: Default wall budget for the whole bring-up (coordinator preflight + the
+#: runtime handshake). Generous over a slow container start, small enough
+#: that a typo'd address fails in CI time, not scheduler time.
+DEFAULT_INIT_TIMEOUT_S = 120.0
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free loopback port for a coordinator. Shared by the
+    dispatcher's fleet phases, the bench's contained fleets and the test
+    probes — one place to harden the allocate-then-bind race window if it
+    ever bites."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class DistributedInitError(RuntimeError):
+    """Multi-host bring-up failed (coordinator unreachable, handshake
+    timeout, or the runtime refused the topology). Raised BEFORE any
+    training state exists, so supervisors can requeue/repair the fleet
+    without a checkpoint-integrity question."""
+
+
+def process_index() -> int:
+    """This process's rank in the global runtime (0 single-process). Safe
+    to call whether or not distributed init ran."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — identity must never crash telemetry
+        return 0
+
+
+def process_count() -> int:
+    """Processes in the global runtime (1 single-process)."""
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # noqa: BLE001 — identity must never crash telemetry
+        return 1
+
+
+def _await_coordinator(address: str, deadline_s: float) -> None:
+    """Preflight: poll a TCP connect to the coordinator endpoint until it
+    accepts or the deadline passes. ``jax.distributed.initialize`` with a
+    wrong address otherwise blocks inside the handshake with no diagnostic;
+    this turns that into a typed, attributable bring-up failure."""
+    host, _, port = address.rpartition(":")
+    try:
+        port_no = int(port)
+    except ValueError as exc:
+        raise DistributedInitError(
+            f"malformed coordinator address {address!r} (expected host:port)"
+        ) from exc
+    deadline = time.monotonic() + deadline_s
+    last_error: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host or "127.0.0.1", port_no),
+                                          timeout=2.0):
+                return
+        except OSError as exc:
+            last_error = exc
+            time.sleep(0.25)
+    raise DistributedInitError(
+        f"coordinator unreachable at {address} after {deadline_s:.0f}s "
+        f"(last error: {last_error}); check --coordinator_address / "
+        "JAX_COORDINATOR_ADDRESS and that process 0 is running"
+    )
+
+
+def _enable_cpu_collectives() -> None:
+    """Switch the CPU client's cross-process collectives to gloo. Without
+    this, multi-process CPU compilation fails with "Multiprocess
+    computations aren't implemented on the CPU backend" — the switch must
+    land before any backend initializes. No-op (and harmless) on TPU
+    backends; tolerant of jax versions without the option."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — option absent on this jax version
+        pass
 
 
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
-) -> None:
+    distributed_init_timeout_s: float | None = None,
+) -> bool:
     """Initializes JAX's distributed runtime when running multi-host.
 
     Opt-in by explicit signal only: passed args, or the
-    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` env vars. With a
-    signal present, ``jax.distributed.initialize`` fills any remaining
-    detail from its cluster auto-detection (Cloud TPU / GKE / Slurm).
-    Without one the call is a no-op — incidental cluster env vars (e.g. an
-    interactive shell inside a Slurm allocation) must not make a
-    single-process run block waiting for peers.
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` env vars. With a signal present,
+    ``jax.distributed.initialize`` fills any remaining detail from its
+    cluster auto-detection (Cloud TPU / GKE / Slurm). Without one the call
+    is a no-op — incidental cluster env vars (e.g. an interactive shell
+    inside a Slurm allocation) must not make a single-process run block
+    waiting for peers. Returns whether the runtime was initialized.
+
+    Fail-fast: the whole bring-up runs under
+    ``distributed_init_timeout_s`` (default
+    :data:`DEFAULT_INIT_TIMEOUT_S`, env
+    ``JAX_DISTRIBUTED_INIT_TIMEOUT_S``) and failures raise the typed
+    :class:`DistributedInitError` instead of blocking forever.
     """
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None:
         coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if distributed_init_timeout_s is None:
+        distributed_init_timeout_s = float(
+            os.environ.get(
+                "JAX_DISTRIBUTED_INIT_TIMEOUT_S", DEFAULT_INIT_TIMEOUT_S
+            )
+        )
 
     explicit = coordinator_address is not None or (
         num_processes is not None and num_processes > 1
     )
     if not explicit:
-        return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+        return False
+
+    import jax
+
+    _enable_cpu_collectives()
+    if coordinator_address is not None and process_id not in (None, 0):
+        # Rank 0 hosts the coordination service itself; every other rank
+        # must be able to reach it, and proves so before committing to the
+        # blocking handshake.
+        _await_coordinator(coordinator_address, distributed_init_timeout_s)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=max(int(distributed_init_timeout_s), 1),
+        )
+    except DistributedInitError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — typed bring-up surface
+        raise DistributedInitError(
+            f"jax.distributed.initialize failed for coordinator "
+            f"{coordinator_address!r} (num_processes={num_processes}, "
+            f"process_id={process_id}): {exc}"
+        ) from exc
+    return True
+
+
+def distributed_config_from_argv(argv=None) -> dict:
+    """The bring-up keys of a CLI invocation, WITHOUT touching jax or the
+    full parser (``get_args`` probes devices, and the probe must happen
+    AFTER ``initialize_distributed`` — ``utils/platform.py``). Reads the
+    four surfaced flags, falling back to the same keys of the
+    ``--name_of_args_json_file`` config, so the dispatcher and hand-rolled
+    fleets can drive bring-up either way."""
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def flag(name: str):
+        token = f"--{name}"
+        if token in argv:
+            i = argv.index(token)
+            if i + 1 < len(argv):
+                return argv[i + 1]
+        for item in argv:  # --name=value form
+            if item.startswith(token + "="):
+                return item.split("=", 1)[1]
+        return None
+
+    config: dict = {}
+    cfg_path = flag("name_of_args_json_file")
+    if cfg_path and cfg_path != "None" and os.path.exists(cfg_path):
+        try:
+            with open(cfg_path) as f:
+                cfg_json = json.load(f)
+        except (OSError, ValueError):
+            cfg_json = {}
+        for key in (
+            "coordinator_address",
+            "num_processes",
+            "process_id",
+            "distributed_init_timeout_s",
+        ):
+            if cfg_json.get(key) is not None:
+                config[key] = cfg_json[key]
+    for key in (
+        "coordinator_address",
+        "num_processes",
+        "process_id",
+        "distributed_init_timeout_s",
+    ):
+        value = flag(key)
+        if value is not None:
+            config[key] = value
+    return config
+
+
+def initialize_distributed_from_argv(argv=None) -> bool:
+    """Entry-point bring-up: pre-parses the surfaced distributed flags (and
+    their config-JSON fallbacks) and initializes the runtime. Must run
+    before any device probe (``get_args``/``jax.devices``) in every entry
+    point — the graftlint ``device-probe-before-distributed-init`` rule
+    enforces the ordering. Returns whether the runtime was initialized."""
+    config = distributed_config_from_argv(argv)
+    address = config.get("coordinator_address")
+    nprocs = config.get("num_processes")
+    pid = config.get("process_id")
+    timeout = config.get("distributed_init_timeout_s")
+    return initialize_distributed(
+        coordinator_address=str(address) if address else None,
+        num_processes=int(nprocs) if nprocs is not None else None,
+        # -1 = unset sentinel (the argparse default): auto-detect.
+        process_id=(
+            int(pid) if pid is not None and int(pid) >= 0 else None
+        ),
+        distributed_init_timeout_s=(
+            float(timeout) if timeout is not None else None
+        ),
     )

@@ -41,34 +41,102 @@ def make_mesh(
     )
 
 
+def multihost_device_order(devices, model_parallel: int = 1) -> list:
+    """Global devices in host-major order for a (dp-across-hosts x
+    mp-within-host) mesh: each process's devices stay CONTIGUOUS along the
+    dp axis (so the per-host batch shards of the per-host data planes land
+    on their own host's devices — ``host_batch_bounds``), and an ``mp``
+    group never straddles a host boundary (tensor-parallel collectives stay
+    on ICI, never DCN). Raises when the topology cannot satisfy that —
+    uneven per-host device counts, or ``mp`` not dividing a host's local
+    device count."""
+    by_host: dict[int, list] = {}
+    for d in devices:
+        by_host.setdefault(int(getattr(d, "process_index", 0)), []).append(d)
+    counts = {len(v) for v in by_host.values()}
+    if len(counts) > 1:
+        raise ValueError(
+            "multi-host mesh needs the same local device count on every "
+            f"host, got {sorted((h, len(v)) for h, v in by_host.items())}"
+        )
+    local = counts.pop()
+    if model_parallel > 1 and local % model_parallel != 0:
+        raise ValueError(
+            f"model_parallel_devices {model_parallel} does not divide the "
+            f"{local} local device(s) per host — an mp group must stay "
+            "within one host (ICI, not DCN)"
+        )
+    ordered = []
+    for host in sorted(by_host):
+        ordered.extend(sorted(by_host[host], key=lambda d: d.id))
+    return ordered
+
+
+def host_batch_bounds(
+    global_batch: int, process_index: int, process_count: int
+) -> tuple[int, int]:
+    """The ``[lo, hi)`` slice of the global meta-batch's task axis that
+    ``process_index``'s data plane owns. The dp mesh axis is host-major
+    (``multihost_device_order``), so NamedSharding's contiguous split of
+    the task axis lands exactly these episodes on this host's devices —
+    which is what lets each host synthesize only its own slice and stage
+    it via ``jax.make_array_from_process_local_data``."""
+    if global_batch % process_count != 0:
+        raise ValueError(
+            f"global meta-batch {global_batch} not divisible by "
+            f"{process_count} processes — per-host data planes slice whole "
+            "episodes"
+        )
+    per_host = global_batch // process_count
+    return process_index * per_host, (process_index + 1) * per_host
+
+
 def default_mesh_from_args(args) -> Mesh | None:
     """Mesh for the CLI entry points: a ``(dp, mp)`` mesh over
     ``data_parallel_devices`` x ``model_parallel_devices`` devices (dp 0 =
-    fill with all local devices), or ``None`` on a single device — the SPMD
+    fill with all GLOBAL devices), or ``None`` on a single device — the SPMD
     replacement for the reference's if-multi-GPU-wrap-DataParallel
     (``few_shot_learning_system.py:73-81``). The global meta-batch must
     divide over ``dp``. ``model_parallel_devices > 1`` opts into the tensor
     (conv-channel) rule set (``sharding.MP_STATE_RULES``) — fenced by
-    ``spmd_compile_guard`` on backends with the GSPMD conv CHECK-crash."""
+    ``spmd_compile_guard`` on backends with the GSPMD conv CHECK-crash.
+
+    Multi-host (``jax.distributed`` initialized, process_count > 1): the
+    mesh spans every host's devices in host-major order — dp ACROSS hosts,
+    mp WITHIN a host — reusing the PR 8 rule tables unchanged (state
+    replicated over dp; the batch's task axis carries the parallelism, one
+    contiguous slice per host — ``host_batch_bounds``). The global
+    meta-batch must additionally divide over the process count, so each
+    host's data plane owns whole episodes."""
     import jax as _jax
 
     mp = int(getattr(args, "model_parallel_devices", 1) or 1)
     n = int(getattr(args, "data_parallel_devices", 0) or 0)
-    devices = _jax.devices()
     if mp < 1:
         raise ValueError(f"model_parallel_devices must be >= 1, got {mp}")
+    nprocs = int(_jax.process_count())
+    devices = (
+        multihost_device_order(_jax.devices(), mp)
+        if nprocs > 1
+        else _jax.devices()
+    )
     if n <= 0:
         n = len(devices) // mp
         if n < 1:
             raise ValueError(
                 f"model_parallel_devices {mp} exceeds the {len(devices)} "
-                "local device(s) — no dp extent fits"
+                "device(s) — no dp extent fits"
             )
     if n * mp == 1:
         return None
     if n * mp > len(devices):
         raise ValueError(
             f"mesh needs {n} x {mp} = {n * mp} devices, have {len(devices)}"
+        )
+    if nprocs > 1 and n * mp != len(devices):
+        raise ValueError(
+            f"multi-host mesh must span all {len(devices)} global devices "
+            f"(got {n} x {mp}); size the fleet instead of subsetting it"
         )
     # The loader's task axis is num_of_gpus * batch_size * samples_per_iter
     # episodes (data/loader.py global_batch).
@@ -81,6 +149,8 @@ def default_mesh_from_args(args) -> Mesh | None:
         raise ValueError(
             f"global meta-batch {batch} not divisible by {n} dp mesh devices"
         )
+    if nprocs > 1:
+        host_batch_bounds(batch, 0, nprocs)  # divisibility guard only
     return make_mesh(devices[: n * mp], data_parallel=n, model_parallel=mp)
 
 
@@ -100,6 +170,38 @@ def degraded_dp_extent(
     n = int(dp) // 2
     while n >= 1:
         if global_batch % n == 0 and (task_chunk <= 0 or task_chunk % n == 0):
+            return n
+        n //= 2
+    return None
+
+
+def degraded_process_count(
+    num_processes: int,
+    *,
+    global_batch: int,
+    local_devices: int = 1,
+    task_chunk: int = 0,
+) -> int | None:
+    """``degraded_dp_extent`` at HOST granularity: the next-smaller viable
+    process count after a host loss (dead worker, hung rank, coordinator
+    heartbeat loss). Each surviving host keeps its ``local_devices`` chips,
+    so candidate fleets have ``dp = n * local_devices`` — viable when the
+    global meta-batch divides both the dp extent (the mesh constraint) and
+    the process count itself (per-host data planes slice whole episodes —
+    ``host_batch_bounds``), honoring an active ``--task_chunk``. Returns
+    ``None`` when no smaller fleet works (already single-host, or nothing
+    divides) — the supervisor then requeues the same topology and lets the
+    host-loss budget decide. Pure host math: safe without touching the
+    (possibly dead) backend."""
+    local = max(int(local_devices), 1)
+    n = int(num_processes) // 2
+    while n >= 1:
+        dp = n * local
+        if (
+            global_batch % dp == 0
+            and global_batch % n == 0
+            and (task_chunk <= 0 or task_chunk % dp == 0)
+        ):
             return n
         n //= 2
     return None

@@ -1,0 +1,91 @@
+"""Per-host data planes: staging local shards into global arrays, and
+gathering global results back to every host.
+
+On a multi-host mesh no single process can materialize a global batch:
+``jax.device_put`` refuses shardings that span non-addressable devices, and
+``np.asarray`` refuses to fetch them back. The two primitives of the
+multi-host data plane are therefore:
+
+* :func:`process_local_put` — each host stages ONLY its own slice of the
+  global meta-batch (the contiguous ``host_batch_bounds`` slice its
+  dp-mesh rows own), and ``jax.make_array_from_process_local_data``
+  assembles the global array view without any cross-host copy. This is the
+  staging callable the PR 7 ``DevicePrefetcher`` plugs in on multi-host
+  runs, so every host keeps the overlapped synthesis→encode→transfer
+  pipeline over its own shard.
+* :func:`gather_global` / :func:`allgather_host` — the read side: a global
+  (possibly task-sharded) device array, or a host-local numpy shard, comes
+  back as the FULL host numpy array on every process (one
+  ``process_allgather`` collective), which is what the test-ensemble
+  phase needs to score global predictions against global targets.
+
+Single-process inputs pass straight through both sides, so every consumer
+can call these unconditionally.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_multiprocess() -> bool:
+    """Whether the global runtime spans more than one process."""
+    from .distributed import process_count
+
+    return process_count() > 1
+
+
+def process_local_put(sharding):
+    """Staging callable for the device prefetcher on multi-host meshes:
+    ``arrays`` (each this process's LOCAL shard, host numpy) -> tuple of
+    GLOBAL jax.Arrays laid out per ``sharding``. The put is per-host
+    asynchronous (no cross-host copy, no forced read): each process hands
+    its addressable shard to the runtime and receives the global view."""
+    import jax
+
+    def put(arrays):
+        return tuple(
+            jax.make_array_from_process_local_data(sharding, np.asarray(a))
+            for a in arrays
+        )
+
+    return put
+
+
+def barrier(tag: str) -> None:
+    """Cross-process barrier (no-op single-process): every rank blocks
+    until all ranks arrive. The write/read fence of the single-writer
+    checkpoint election — rank 0 drains its async writer, THEN all ranks
+    barrier, THEN readers may load (without it a non-chief rank races the
+    chief's tmp+rename and reads a missing or stale file)."""
+    if not is_multiprocess():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def gather_global(array) -> np.ndarray:
+    """A (possibly non-addressable, task-sharded) global device array ->
+    the full host numpy array, identical on every process. Fully
+    addressable inputs take the ordinary zero-collective fetch."""
+    import jax
+
+    if getattr(array, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(array))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(array, tiled=True))
+
+
+def allgather_host(array) -> np.ndarray:
+    """A HOST-local numpy shard (e.g. this process's slice of the episode
+    targets) -> the concatenation of every process's shard along axis 0,
+    identical on every process. Identity single-process."""
+    if not is_multiprocess():
+        return np.asarray(array)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(array), tiled=True)
+    )

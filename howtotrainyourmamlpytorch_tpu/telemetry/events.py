@@ -149,14 +149,22 @@ class EventLog:
 def read_events(path: str) -> list[dict]:
     """Parses a telemetry JSONL file back into event dicts (blank lines
     skipped). Raises ``ValueError`` on a schema line newer than this
-    build understands — refuse to misread rather than silently drop."""
+    build understands — refuse to misread rather than silently drop.
+    Unparseable lines are skipped with a stderr warning: on multi-host
+    runs every rank appends to the shared JSONL, and a rare torn line
+    from concurrent appends must not make the whole stream unreadable."""
     events = []
+    torn = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            record = json.loads(line)
+            try:
+                record = json.loads(line)
+            except ValueError:
+                torn += 1
+                continue
             if record.get("type") == "schema":
                 version = int(record.get("version", -1))
                 if version > SCHEMA_VERSION:
@@ -165,6 +173,12 @@ def read_events(path: str) -> list[dict]:
                         f"this build reads (up to {SCHEMA_VERSION})"
                     )
             events.append(record)
+    if torn:
+        print(
+            f"WARNING: skipped {torn} unparseable line(s) in {path} "
+            "(concurrent multi-rank appends can tear a line)",
+            file=sys.stderr,
+        )
     return events
 
 

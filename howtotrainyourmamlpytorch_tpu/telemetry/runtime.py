@@ -60,6 +60,8 @@ class TrainTelemetry:
         n_devices: int = 1,
         mesh_dp: int = 1,
         mesh_mp: int = 1,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
         self.enabled = bool(enabled)
         self.logs_dir = logs_dir
@@ -71,6 +73,12 @@ class TrainTelemetry:
         self.n_devices = int(n_devices)
         self.mesh_dp = int(mesh_dp)
         self.mesh_mp = int(mesh_mp)
+        # Host identity (multi-host fleets): stamped on step/preemption/
+        # requeue events and the epoch CSV, so a multi-rank telemetry
+        # stream (all ranks append to the shared JSONL) attributes every
+        # fault to the rank that saw it.
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
         self.mesh_shape = (
             f"dp{self.mesh_dp}xmp{self.mesh_mp}"
             if self.n_devices > 1
@@ -155,6 +163,11 @@ class TrainTelemetry:
 
     def event(self, event_type: str, **fields) -> None:
         if self.events is not None:
+            # Host identity on every trainer-emitted event (preemption,
+            # requeue_exit, rollback, nonfinite_trip, ...): multi-rank
+            # streams append to one JSONL, and attribution is the point.
+            fields.setdefault("process_index", self.process_index)
+            fields.setdefault("process_count", self.process_count)
             self.events.emit(event_type, **fields)
 
     def record_dispatch(
@@ -208,6 +221,8 @@ class TrainTelemetry:
                     device_s=device_s,
                     n_devices=self.n_devices,
                     mesh_shape=self.mesh_shape,
+                    process_index=self.process_index,
+                    process_count=self.process_count,
                 )
         self._last_dispatch_t = now
         self.profiler.tick(n_iters)
@@ -268,6 +283,8 @@ class TrainTelemetry:
         stats["n_devices"] = self.n_devices
         stats["mesh_dp"] = self.mesh_dp
         stats["mesh_mp"] = self.mesh_mp
+        stats["process_index"] = self.process_index
+        stats["process_count"] = self.process_count
         if self.events is not None:
             self.events.emit(
                 "epoch_summary",
@@ -295,11 +312,12 @@ class TrainTelemetry:
     def _on_compile(self, event) -> None:
         """Bridge from ``utils/sanitize.compile_listener``: one event per
         XLA compile, named + signature-indexed (the recompile classes the
-        compile guard pins)."""
+        compile guard pins). Routed through ``event`` so multi-host runs
+        attribute each compile to its rank — the per-rank compile-once pin
+        of tests/test_multihost.py reads exactly this."""
         self.registry.counter("xla_compiles").inc()
-        if self.events is not None:
-            self.events.emit(
-                "compile",
-                name=event.name,
-                signature=event.signature[:_SIGNATURE_CHARS],
-            )
+        self.event(
+            "compile",
+            name=event.name,
+            signature=event.signature[:_SIGNATURE_CHARS],
+        )

@@ -184,6 +184,28 @@ def get_parser() -> argparse.ArgumentParser:
              "devices after model_parallel_devices); shards the task axis "
              "of the meta-batch over 'dp' — parallel/sharding declares the "
              "layout, the stager stages straight into it")
+    # Multi-host bring-up (parallel/distributed.py). These are PRE-PARSED
+    # by initialize_distributed_from_argv in every entry point BEFORE this
+    # parser runs (jax.distributed.initialize must precede any device
+    # probe, and get_args probes); they are declared here so the full
+    # parser accepts them, configs can carry them, and --help documents
+    # them. Opt-in by explicit signal only: absent, a run is
+    # single-process regardless of cluster env vars.
+    add("--coordinator_address", type=str, default=None,
+        help="host:port of the jax.distributed coordinator (rank 0). "
+             "Setting this (or JAX_COORDINATOR_ADDRESS) opts the run into "
+             "multi-host bring-up before any device probe")
+    add("--num_processes", type=int, default=0,
+        help="process count of the multi-host fleet (0 = single-process / "
+             "auto-detect; also JAX_NUM_PROCESSES)")
+    add("--process_id", type=int, default=-1,
+        help="this process's rank in the fleet (-1 = auto-detect; also "
+             "JAX_PROCESS_ID). Rank 0 hosts the coordination service")
+    add("--distributed_init_timeout_s", type=float, default=None,
+        help="wall budget for multi-host bring-up (coordinator preflight + "
+             "runtime handshake); an unreachable coordinator fails with a "
+             "typed DistributedInitError instead of blocking forever "
+             "(default 120; also JAX_DISTRIBUTED_INIT_TIMEOUT_S)")
     add("--model_parallel_devices", type=int, default=1,
         help="mp extent of the device mesh (tensor parallelism): conv "
              "filters sharded over output channels + row-parallel linear "
@@ -370,6 +392,33 @@ def get_args(argv=None):
             "highest (see PERF_NOTES.md).",
             file=sys.stderr,
         )
+
+    # Host identity (multi-host runs; 0-of-1 single-process). Stamped here
+    # once so every consumer — telemetry attribution, the loader's
+    # per-host data-plane shard, checkpoint-writer election — reads the
+    # same resolved values. initialize_distributed ran in the entry point
+    # BEFORE this probe (the graftlint device-probe-before-distributed-init
+    # ordering), so process_count is already the fleet's. A multi-process
+    # fleet whose flags disagree with the live runtime is a config bug —
+    # fail loud, not with a wedged collective later.
+    args.process_index = int(jax.process_index())
+    args.process_count = int(jax.process_count())
+    want_procs = int(getattr(args, "num_processes", 0) or 0)
+    if want_procs > 1 and args.process_count != want_procs:
+        raise ValueError(
+            f"--num_processes {want_procs} but the runtime spans "
+            f"{args.process_count} process(es) — was initialize_distributed "
+            "called before get_args, with a reachable "
+            f"--coordinator_address (timeout "
+            f"{getattr(args, 'distributed_init_timeout_s', None)})?"
+        )
+    # Per-host data plane: each process's loader synthesizes only its own
+    # contiguous slice of the global meta-batch (seeds stay global-index
+    # keyed, so the assembled global batch is bit-identical at any host
+    # count — parallel/mesh.host_batch_bounds). Explicit config values win.
+    if int(getattr(args, "data_shard_count", 0) or 0) < 1:
+        args.data_shard_index = args.process_index
+        args.data_shard_count = args.process_count
 
     device = jax.devices()[0]
     print("use device", device)

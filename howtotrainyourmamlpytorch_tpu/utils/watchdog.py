@@ -104,6 +104,7 @@ class DispatchWatchdog:
         on_hang=None,
         exit_fn=os._exit,
         clock=time.monotonic,
+        identity: dict | None = None,
     ):
         if min_deadline_s <= 0:
             raise ValueError(
@@ -115,6 +116,11 @@ class DispatchWatchdog:
         self._on_hang = on_hang
         self._exit_fn = exit_fn
         self._clock = clock
+        # Host identity fields (process_index/process_count on multi-host
+        # fleets) merged into the hang telemetry event: a wedged collective
+        # looks identical on every surviving rank, and the post-mortem
+        # needs to know WHICH rank's watchdog spoke.
+        self._identity = dict(identity or {})
 
         self._cond = threading.Condition()
         self._samples: list[float] = []
@@ -147,25 +153,39 @@ class DispatchWatchdog:
             if len(self._samples) > _MAX_SAMPLES:
                 del self._samples[: -_MAX_SAMPLES]
 
-    def deadline_s(self) -> float:
-        """``max(min_deadline_s, factor * p95(observed step times))``."""
+    def deadline_s(self, scale: float = 1.0) -> float:
+        """``max(min_deadline_s, factor * p95(observed step times) *
+        scale)`` — ``scale`` covers armed windows that legitimately span
+        several dispatches' worth of device work."""
         with self._cond:
             samples = list(self._samples)
         if not samples:
             return self.min_deadline_s
         samples.sort()
         p95 = samples[min(int(0.95 * len(samples)), len(samples) - 1)]
-        return max(self.min_deadline_s, self.factor * p95)
+        return max(self.min_deadline_s, self.factor * p95 * max(scale, 1.0))
 
     # ------------------------------------------------------------------
     # Arming
     # ------------------------------------------------------------------
 
     @contextlib.contextmanager
-    def armed(self, current_iter: int = 0):
+    def armed(self, current_iter: int = 0, observe: bool = True,
+              scale: float = 1.0):
         """Arms the deadline around one dispatch; a clean exit disarms and
-        feeds the elapsed wall time back into the distribution."""
-        deadline = self.deadline_s()
+        feeds the elapsed wall time back into the distribution.
+
+        ``observe=False`` arms WITHOUT feeding the sample back — for
+        non-dispatch forced-read windows (the epoch-boundary summary sync,
+        where a lost multi-host peer wedges the survivor exactly like a
+        stuck collective): their legitimate duration (val epoch +
+        checkpoint) must not inflate the per-dispatch p95 the deadline is
+        derived from. ``scale`` stretches the p95-derived half of the
+        deadline for windows legitimately spanning many dispatches (the
+        boundary's validation epoch): ``max(min_deadline_s, factor * p95
+        * scale)`` — still finite, never false-tripping on healthy
+        length."""
+        deadline = self.deadline_s(scale)
         with self._cond:
             self._armed_at = self._clock()
             self._armed_iter = int(current_iter)
@@ -183,7 +203,8 @@ class DispatchWatchdog:
                 )
                 self._armed_at = None
                 self._cond.notify_all()
-            self.observe(elapsed)
+            if observe:
+                self.observe(elapsed)
 
     # ------------------------------------------------------------------
     # Monitor thread
@@ -245,6 +266,7 @@ class DispatchWatchdog:
             stack_path=stack_path,
             stacks=stacks[:_EVENT_STACK_CHARS],
             exit_code=HANG_EXIT_CODE,
+            **self._identity,
         )
         unwind = threading.Thread(
             target=self._unwind,

@@ -645,3 +645,66 @@ def test_thread_lifecycle_negative_fire_and_forget_out_of_scope():
     """
     # No retained handle -> nothing a shutdown path could join.
     assert "thread-lifecycle" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# device-probe-before-distributed-init
+# ---------------------------------------------------------------------------
+
+
+def test_device_probe_before_init_positive_module_level():
+    src = """
+    import jax
+    from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+
+    devices = jax.devices()
+    initialize_distributed()
+    """
+    assert "device-probe-before-distributed-init" in rules_of(src)
+
+
+def test_device_probe_before_init_positive_probe_without_any_init_call():
+    src = """
+    import jax
+    from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+
+    n = len(jax.local_devices())
+    """
+    assert "device-probe-before-distributed-init" in rules_of(src)
+
+
+def test_device_probe_before_init_positive_inside_main():
+    src = """
+    import jax
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        initialize_distributed_from_argv,
+    )
+
+    def main():
+        kind = jax.devices()[0].device_kind
+        initialize_distributed_from_argv()
+        return kind
+    """
+    assert "device-probe-before-distributed-init" in rules_of(src)
+
+
+def test_device_probe_after_init_negative():
+    src = """
+    import jax
+    from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
+    devices = jax.devices()
+    """
+    assert "device-probe-before-distributed-init" not in rules_of(src)
+
+
+def test_device_probe_negative_module_without_bringup_import():
+    # A module with no multi-host ambition may probe devices freely — the
+    # ordering contract binds only files that import the bring-up helper.
+    src = """
+    import jax
+
+    devices = jax.devices()
+    """
+    assert "device-probe-before-distributed-init" not in rules_of(src)

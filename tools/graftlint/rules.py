@@ -702,6 +702,93 @@ class ThreadLifecycleRule(Rule):
                 )
 
 
+class DeviceProbeBeforeDistributedInitRule(Rule):
+    id = "device-probe-before-distributed-init"
+    summary = (
+        "jax.devices()/jax.local_devices() probed before "
+        "initialize_distributed in a multi-host entry point — the probe "
+        "initializes the XLA backend, after which the runtime can never "
+        "span hosts (utils/platform.py documents the ordering)"
+    )
+
+    #: jax calls that initialize the backend (after which
+    #: jax.distributed.initialize cannot take effect for this process).
+    PROBES = {
+        "jax.devices",
+        "jax.local_devices",
+        "jax.device_count",
+        "jax.local_device_count",
+    }
+    INIT_NAMES = (
+        "initialize_distributed",
+        "initialize_distributed_from_argv",
+    )
+
+    def _imports_init(self, module: ModuleFile) -> bool:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                if any(a.name in self.INIT_NAMES for a in node.names):
+                    return True
+        return False
+
+    def _is_init_call(self, call: ast.Call, module: ModuleFile) -> bool:
+        resolved = resolve_dotted(call.func, module.aliases) or ""
+        return resolved.rpartition(".")[2] in self.INIT_NAMES
+
+    def _is_probe_call(self, call: ast.Call, module: ModuleFile) -> bool:
+        resolved = resolve_dotted(call.func, module.aliases) or ""
+        return resolved in self.PROBES
+
+    def check(self, module, project):
+        # Scope: only modules that IMPORT the bring-up helper — exactly
+        # the entry points whose ordering the contract constrains. A
+        # module with no multi-host ambition may probe devices freely.
+        if not self._imports_init(module):
+            return
+        scopes: list[ast.AST] = [module.tree] + [
+            n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        module_has_init = any(
+            isinstance(n, ast.Call) and self._is_init_call(n, module)
+            for n in ast.walk(module.tree)
+        )
+        for scope in scopes:
+            init_lines = []
+            probes = []
+            for node in _scope_nodes(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._is_init_call(node, module):
+                    init_lines.append(node.lineno)
+                elif self._is_probe_call(node, module):
+                    probes.append(node)
+            first_init = min(init_lines) if init_lines else None
+            for probe in probes:
+                if first_init is not None and probe.lineno < first_init:
+                    yield self._v(
+                        module,
+                        probe,
+                        "device probe before initialize_distributed — the "
+                        "probe initializes the XLA backend, so the later "
+                        "bring-up call can never make this process join a "
+                        "multi-host runtime; call initialize_distributed "
+                        "first (utils/platform.py documents the ordering)",
+                    )
+                elif first_init is None and not module_has_init and (
+                    scope is module.tree
+                ):
+                    yield self._v(
+                        module,
+                        probe,
+                        "module-level device probe in a file that imports "
+                        "initialize_distributed but never calls it — the "
+                        "probe pins this process single-host before any "
+                        "bring-up can run",
+                    )
+
+
 ALL_RULES: list[Rule] = [
     PRNGReuseRule(),
     HostNumpyInTraceRule(),
@@ -712,4 +799,5 @@ ALL_RULES: list[Rule] = [
     DeviceOpInDataPathRule(),
     TracedMutationRule(),
     ThreadLifecycleRule(),
+    DeviceProbeBeforeDistributedInitRule(),
 ]

@@ -139,6 +139,16 @@ def build_pool(opts):
 
 
 def main(argv=None) -> int:
+    # Multi-host bring-up BEFORE any device probe (the same ordering the
+    # training entry points follow, enforced by graftlint's
+    # device-probe-before-distributed-init): a no-op without an explicit
+    # env signal, and fail-fast with a typed DistributedInitError when a
+    # configured coordinator is unreachable.
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        initialize_distributed_from_argv,
+    )
+
+    initialize_distributed_from_argv([])
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--config", required=True,
                         help="experiment config JSON (the training run's)")

@@ -128,11 +128,22 @@ def summarize(events: list[dict]) -> dict:
     mesh_shapes = sorted(
         {str(e.get("mesh_shape", "single")) for e in steps}
     ) or ["single"]
+    # Host attribution (multi-host fleets append to one JSONL): which
+    # ranks contributed events, out of how many. Pre-multi-host logs
+    # default to rank 0 of 1.
+    process_count = max(
+        (int(e.get("process_count", 1)) for e in events), default=1
+    )
+    process_indices = sorted(
+        {int(e.get("process_index", 0)) for e in events if "process_index" in e}
+    ) or [0]
     return {
         "schema": SCHEMA_VERSION,
         "iters": len(per_iter["step"]),
         "n_devices": n_devices,
         "mesh_shape": "+".join(mesh_shapes),
+        "process_count": process_count,
+        "process_indices": process_indices,
         "breakdown": breakdown,
         "compiles": compiles,
         "events": log,
@@ -142,11 +153,14 @@ def summarize(events: list[dict]) -> dict:
 
 def render_text(summary: dict) -> str:
     lines = []
+    ranks = summary.get("process_indices", [0])
     lines.append(
         f"telemetry report — {summary['iters']} train iterations, "
         f"schema v{summary['schema']}, "
         f"{summary.get('n_devices', 1)} device(s) "
-        f"[{summary.get('mesh_shape', 'single')}]"
+        f"[{summary.get('mesh_shape', 'single')}], "
+        f"rank(s) {'+'.join(str(r) for r in ranks)} of "
+        f"{summary.get('process_count', 1)} process(es)"
     )
     lines.append("")
     lines.append("step-time breakdown (per iteration)")

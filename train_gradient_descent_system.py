@@ -5,7 +5,7 @@ from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
 from howtotrainyourmamlpytorch_tpu.parallel import (
     default_mesh_from_args,
-    initialize_distributed,
+    initialize_distributed_from_argv,
 )
 from howtotrainyourmamlpytorch_tpu.models import GradientDescentLearner
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
@@ -15,7 +15,9 @@ from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
 )
 
 if __name__ == "__main__":
-    initialize_distributed()  # no-op without explicit multi-host env signal
+    # Multi-host bring-up BEFORE any device probe (no-op without an
+    # explicit flag/config/env signal — parallel/distributed.py).
+    initialize_distributed_from_argv()
     args, device = get_args()
     model = GradientDescentLearner(
         cfg=args_to_maml_config(args), mesh=default_mesh_from_args(args)

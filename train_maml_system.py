@@ -11,7 +11,7 @@ from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
 from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
 from howtotrainyourmamlpytorch_tpu.parallel import (
     default_mesh_from_args,
-    initialize_distributed,
+    initialize_distributed_from_argv,
 )
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
 from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
@@ -21,8 +21,9 @@ from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
 
 if __name__ == "__main__":
     # Multi-host: must run before any backend use so the mesh spans all
-    # hosts' chips (no-op without JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES).
-    initialize_distributed()
+    # hosts' chips (no-op without --coordinator_address/--num_processes
+    # flags, their config-JSON keys, or the JAX_* env equivalents).
+    initialize_distributed_from_argv()
     args, device = get_args()
     model = MAMLFewShotLearner(
         cfg=args_to_maml_config(args), mesh=default_mesh_from_args(args)

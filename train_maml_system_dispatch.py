@@ -33,10 +33,31 @@ eval has been produced, supervising the training process like
   heal; a re-hang simply degrades again, on budget. Every degrade/promote
   appends an audit row to ``<experiment>/logs/interruptions.csv``.
 
+* multi-host fleet supervision (``--num_processes N``): each phase spawns N
+  worker processes over a loopback coordinator (fresh free port per phase;
+  rank 0 hosts the coordination service, every rank gets
+  ``--coordinator_address/--num_processes/--process_id`` flags — bring-up
+  flags beat config keys in ``parallel/distributed.py``, so the same
+  config JSON drives any fleet size). HOST LOSS — any rank dead by signal,
+  hung (rc 76 from the PR 10 watchdog, which fires on the surviving ranks
+  when a peer's collective goes silent), or crashed — triggers COORDINATED
+  SHUTDOWN of the survivors (grace for their own watchdog exit, then
+  SIGTERM, then SIGKILL), a host-attributed audit row, and degraded-mesh
+  auto-resume on the next-smaller viable process count
+  (``parallel/mesh.degraded_process_count``) from the last published
+  checkpoint — rank 0 is the single checkpoint writer, and checkpoints are
+  mesh-portable, so a 2-host run resumes on 1 host bit-compatibly. Host
+  losses draw on the ``--max_hangs`` budget (the topology is suspect); a
+  fleet-wide preemption (every rank exits 75) draws on ``--max_requeues``
+  and resumes the SAME fleet. After a clean degraded phase the
+  re-promotion probe restores the previous fleet size.
+
 ``MAML_FAULTS`` (utils/faultinject.py) is consumed by the FIRST phase only:
 env fault plans are one-shot per dispatcher run, so a requeued/degraded
 phase replays clean instead of deterministically re-hitting the same
-injected fault every restart.
+injected fault every restart. In fleet mode ``--fault_rank R`` targets the
+plan at one rank (the kill-a-host chaos class needs exactly one host to
+die); without it every rank inherits the plan.
 
 Progress is tracked via the experiment's ``logs/summary_statistics.csv`` row
 count; a phase that makes no progress twice in a row aborts (rc of that
@@ -44,6 +65,7 @@ phase, or 1 if it reported success while stuck).
 """
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -69,19 +91,30 @@ def _pop_flag(extra, name, default, cast):
     return default
 
 
-def _audit_row(exp_name: str, kind: str) -> None:
+def _audit_row(exp_name: str, kind: str, process_index="",
+               process_count="", when: float | None = None) -> None:
     """Appends a dispatcher audit row to the experiment's interruptions
-    CSV (same 4-column header the builder's preemption rows use, so one
-    file holds the full interruption history)."""
+    CSV (same header the builder's preemption rows use, so one file holds
+    the full interruption history). ``process_index``/``process_count``
+    attribute host-loss rows to the rank that died; supervisor-policy rows
+    (degrade/promote) leave them empty. Rows align to the file's existing
+    header so pre-multi-host experiments keep their 4-column layout."""
     logs = os.path.join(exp_name, "logs")
+    header = ("timestamp,signal,current_iter,epoch,"
+              "process_index,process_count")
     try:
         os.makedirs(logs, exist_ok=True)
         path = os.path.join(logs, "interruptions.csv")
         if not os.path.exists(path):
             with open(path, "w") as f:
-                f.write("timestamp,signal,current_iter,epoch\n")
+                f.write(header + "\n")
+        with open(path) as f:
+            n_cols = len(f.readline().rstrip("\n").split(","))
+        row = [str(time.time() if when is None else when), str(kind),
+               "", "",
+               str(process_index), str(process_count)][:max(n_cols, 4)]
         with open(path, "a") as f:
-            f.write(f"{time.time()},{kind},,\n")
+            f.write(",".join(row) + "\n")
     except OSError:
         pass  # auditing must not break supervision
 
@@ -101,19 +134,148 @@ def _resolved_dp(cfg_dict: dict, extra: list) -> int:
     return max(len(jax.devices()) // max(mp, 1), 1)
 
 
-def _next_smaller_dp(cfg_dict: dict, current_dp: int) -> int | None:
-    from howtotrainyourmamlpytorch_tpu.parallel.mesh import degraded_dp_extent
-
-    global_batch = (
+def _global_batch(cfg_dict: dict) -> int:
+    return (
         int(cfg_dict.get("num_of_gpus", 1) or 1)
         * int(cfg_dict.get("batch_size", 32))
         * int(cfg_dict.get("samples_per_iter", 1) or 1)
     )
+
+
+def _next_smaller_dp(cfg_dict: dict, current_dp: int) -> int | None:
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import degraded_dp_extent
+
     return degraded_dp_extent(
         current_dp,
-        global_batch=global_batch,
+        global_batch=_global_batch(cfg_dict),
         task_chunk=int(cfg_dict.get("task_chunk", 0) or 0),
     )
+
+
+def _next_smaller_procs(
+    cfg_dict: dict, current_procs: int, local_devices: int
+) -> int | None:
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        degraded_process_count,
+    )
+
+    return degraded_process_count(
+        current_procs,
+        global_batch=_global_batch(cfg_dict),
+        local_devices=local_devices,
+        task_chunk=int(cfg_dict.get("task_chunk", 0) or 0),
+    )
+
+
+def _free_port() -> int:
+    from howtotrainyourmamlpytorch_tpu.parallel.distributed import (
+        find_free_port,
+    )
+
+    return find_free_port()
+
+
+def _run_fleet(
+    entry: str,
+    run_cfg_path: str,
+    extra: list,
+    num_processes: int,
+    child_env: dict,
+    fault_rank: int | None,
+    grace_s: float,
+) -> tuple[list[int], int | None, float | None]:
+    """One multi-host phase: spawn ``num_processes`` ranks over a fresh
+    loopback coordinator and supervise to fleet exit. Once ANY rank exits,
+    the fleet is no longer whole — survivors get ``grace_s`` to exit on
+    their own (a peer-loss hang ends in the rank's OWN watchdog rc 76,
+    which is evidence worth keeping), then SIGTERM, then SIGKILL. Returns
+    ``(per-rank exit codes, first-exit rank, first-exit unix time)`` —
+    when the fleet dies, the FIRST rank to exit is the root cause (the
+    lost host); later deaths are symptoms (peer-loss watchdog exits, or
+    this supervisor's own shutdown), so exit ORDER is the attribution
+    signal, not exit codes — and the first-exit TIME is the host-loss
+    instant recovery is measured from.
+    ``num_processes == 1`` spawns a plain single-process child (no
+    distributed flags — opt-in stays explicit)."""
+    dist_flags: list[str] = []
+    if num_processes > 1:
+        addr = f"127.0.0.1:{_free_port()}"
+        dist_flags = [
+            "--coordinator_address", addr,
+            "--num_processes", str(num_processes),
+        ]
+    procs: list[subprocess.Popen] = []
+    for rank in range(num_processes):
+        env = dict(child_env)
+        if fault_rank is not None and rank != fault_rank:
+            env.pop("MAML_FAULTS", None)
+        rank_flags = dist_flags + (
+            ["--process_id", str(rank)] if num_processes > 1 else []
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", entry, "--name_of_args_json_file",
+             run_cfg_path, *extra, *rank_flags],
+            env=env,
+        ))
+    first_exit_t: float | None = None
+    first_exit_rank: int | None = None
+    first_exit_wall: float | None = None
+    terminated = killed = False
+    while any(p.poll() is None for p in procs):
+        if any(p.poll() is not None for p in procs):
+            now = time.monotonic()
+            if first_exit_t is None:
+                first_exit_t = now
+                first_exit_wall = time.time()
+                first_exit_rank = next(
+                    i for i, p in enumerate(procs) if p.poll() is not None
+                )
+            elif not terminated and now - first_exit_t > grace_s:
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+                terminated = True
+                first_exit_t = now
+            elif terminated and not killed and now - first_exit_t > 15.0:
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.kill()
+                        except OSError:
+                            pass
+                killed = True
+        time.sleep(0.25)
+    return [p.wait() for p in procs], first_exit_rank, first_exit_wall
+
+
+def _classify_fleet(
+    rcs: list[int], first_exit_rank: int | None
+) -> tuple[int, int | None]:
+    """Fleet exit codes -> (phase rc, failing rank). All-zero is success;
+    a fleet-wide preemption (every rank 0/75, at least one 75) is a
+    requeue; ANY rank dead-by-signal / hung (76) / crashed is a HOST LOSS
+    (reported as the hang code — the topology is suspect). Attribution:
+    the FIRST rank to exit abnormally is the root cause — later deaths
+    are symptoms (peer-loss watchdog exits, supervisor shutdown)."""
+    if all(rc == 0 for rc in rcs):
+        return 0, None
+    if all(rc in (0, REQUEUE_EXIT_CODE) for rc in rcs):
+        return REQUEUE_EXIT_CODE, None
+    # Anything else — dead-by-signal, hung (76), or a plain crash — is a
+    # host loss: the fleet cannot make progress with that rank gone
+    # either way, and the degraded resume (budget-bounded by
+    # --max_hangs) is the recovery for all of them. A deterministic
+    # code bug that crashes every fleet size exhausts the budget and
+    # aborts rather than looping.
+    bad = [
+        rank for rank, rc in enumerate(rcs)
+        if rc not in (0, REQUEUE_EXIT_CODE)
+    ]
+    blamed = first_exit_rank if first_exit_rank in bad else bad[0]
+    return HANG_EXIT_CODE, blamed
 
 
 def main() -> int:
@@ -132,6 +294,12 @@ def main() -> int:
     # the two failure classes must not starve each other's recovery.
     max_requeues = _pop_flag(extra, "--max_requeues", 100, int)
     max_hangs = _pop_flag(extra, "--max_hangs", 8, int)
+    # Multi-host fleet supervision: N worker processes per phase over a
+    # loopback coordinator (0/1 = the classic single-process path).
+    num_processes = _pop_flag(extra, "--num_processes", 0, int) or 0
+    fault_rank = _pop_flag(extra, "--fault_rank", None, int)
+    fleet_grace_s = _pop_flag(extra, "--fleet_grace_s", 30.0, float)
+    fleet = num_processes > 1
 
     entry = os.environ.get(ENTRY_ENV) or (
         "train_gradient_descent_system.py" if "gradient-descent" in cfg
@@ -139,14 +307,20 @@ def main() -> int:
         else "train_maml_system.py")
     # Canonical configs live in experiment_config/ (the reference's 38-file
     # surface, content-tested); local variants (bf16, resnet12, ...) in
-    # experiment_config_local/ so regeneration identity stays intact.
-    for d in ("experiment_config", "experiment_config_local"):
-        cfg_path = f"{d}/{cfg}.json"
-        if os.path.exists(cfg_path):
-            break
+    # experiment_config_local/ so regeneration identity stays intact. A
+    # direct .json path (chaos harness workdirs, ad-hoc fleets) is used
+    # as-is.
+    if cfg.endswith(".json") and os.path.exists(cfg):
+        cfg_path = cfg
     else:
-        raise FileNotFoundError(f"no config named {cfg} in experiment_config"
-                                "{,_local}/")
+        for d in ("experiment_config", "experiment_config_local"):
+            cfg_path = f"{d}/{cfg}.json"
+            if os.path.exists(cfg_path):
+                break
+        else:
+            raise FileNotFoundError(
+                f"no config named {cfg} in experiment_config{{,_local}}/"
+            )
     with open(cfg_path) as f:
         cfg_dict = json.load(f)
     exp_name = cfg_dict["experiment_name"]
@@ -193,8 +367,9 @@ def main() -> int:
         if not overrides:
             run_cfg_path = cfg_path
             return
+        cfg_tag = os.path.splitext(os.path.basename(cfg))[0]
         patched = tempfile.NamedTemporaryFile(
-            "w", suffix=f"_{cfg}.json", delete=False
+            "w", suffix=f"_{cfg_tag}.json", delete=False
         )
         json.dump({**cfg_dict, **overrides}, patched)
         patched.close()
@@ -202,9 +377,18 @@ def main() -> int:
 
     write_patched()
 
-    # Degraded-mesh state: dp extents we stepped down from, newest last —
-    # popped one level at each re-promotion probe.
+    # Degraded-mesh state: dp extents (fleet mode: process counts) we
+    # stepped down from, newest last — popped one level at each
+    # re-promotion probe.
     promote_stack: list[int] = []
+    # Fleet mode: the per-host device count is fixed by the hardware; a
+    # degraded fleet keeps it and shrinks the dp extent proportionally.
+    current_procs = num_processes if fleet else 1
+    local_devices = (
+        max(int(cfg_dict.get("data_parallel_devices", 0) or 0)
+            // num_processes, 1)
+        if fleet else 1
+    )
 
     try:
         max_phases = 2 * (total_epochs // (pause_every or total_epochs) + 2)
@@ -218,12 +402,24 @@ def main() -> int:
         ):
             before = epochs_logged()
             print(f"--- {cfg}: phase {phase} via {entry} "
-                  f"(epochs logged: {before}/{total_epochs})", flush=True)
-            proc = subprocess.run(
-                [sys.executable, "-u", entry, "--name_of_args_json_file",
-                 run_cfg_path, *extra], check=False, env=child_env,
-            )
-            rc = proc.returncode
+                  f"(epochs logged: {before}/{total_epochs}"
+                  + (f", fleet of {current_procs}" if fleet else "")
+                  + ")", flush=True)
+            bad_rank = None
+            if fleet:
+                rcs, first_exit_rank, first_exit_wall = _run_fleet(
+                    entry, run_cfg_path, extra, current_procs,
+                    child_env, fault_rank, fleet_grace_s,
+                )
+                rc, bad_rank = _classify_fleet(rcs, first_exit_rank)
+                print(f"--- {cfg}: fleet rcs {rcs} -> phase rc {rc}",
+                      flush=True)
+            else:
+                proc = subprocess.run(
+                    [sys.executable, "-u", entry, "--name_of_args_json_file",
+                     run_cfg_path, *extra], check=False, env=child_env,
+                )
+                rc = proc.returncode
             # Env fault plans are one-shot per dispatcher run: the phase
             # that just ran consumed them; a requeued/degraded phase must
             # replay clean, not re-hit the same injected fault forever.
@@ -238,12 +434,59 @@ def main() -> int:
             signal_deaths = signal_deaths + 1 if died_by_signal else 0
             if rc == HANG_EXIT_CODE or signal_deaths >= 2:
                 # Suspect the topology: a wedged dispatch (watchdog
-                # diagnostic in logs/hang_stacks.txt) or a device that
-                # keeps killing its worker. Resume the same experiment on
-                # the next-smaller viable mesh, from the last valid
+                # diagnostic in logs/hang_stacks.txt), a device that
+                # keeps killing its worker, or — fleet mode — a HOST LOSS
+                # (any rank dead/hung; survivors were shut down in
+                # coordination). Resume the same experiment on the
+                # next-smaller viable mesh/fleet, from the last valid
                 # checkpoint (mesh-portable restore).
                 hangs += 1
                 stalled = signal_deaths = 0
+                if fleet:
+                    smaller = _next_smaller_procs(
+                        cfg_dict, current_procs, local_devices
+                    )
+                    why = (f"host-loss:rank{bad_rank}"
+                           if bad_rank is not None else "host-loss")
+                    if smaller is not None:
+                        promote_stack.append(current_procs)
+                        # Stamped with the OBSERVED first-exit time: the
+                        # row marks when the host was lost, not when this
+                        # supervisor finished coordinating the shutdown —
+                        # recovery time is measured from it.
+                        _audit_row(
+                            exp_name,
+                            f"{why}-degrade:procs{current_procs}->"
+                            f"procs{smaller}",
+                            process_index=(
+                                bad_rank if bad_rank is not None else ""
+                            ),
+                            process_count=current_procs,
+                            when=first_exit_wall,
+                        )
+                        print(f"--- {cfg}: {why} (rc {rc}); degrading "
+                              f"fleet {current_procs} -> {smaller} "
+                              "process(es), resuming from the last valid "
+                              "checkpoint", flush=True)
+                        current_procs = smaller
+                        overrides["data_parallel_devices"] = (
+                            smaller * local_devices
+                        )
+                        write_patched()
+                    else:
+                        _audit_row(
+                            exp_name,
+                            f"{why}-requeue:procs{current_procs}",
+                            process_index=(
+                                bad_rank if bad_rank is not None else ""
+                            ),
+                            process_count=current_procs,
+                            when=first_exit_wall,
+                        )
+                        print(f"--- {cfg}: {why} (rc {rc}) with no "
+                              "smaller viable fleet; requeueing on the "
+                              "same topology", flush=True)
+                    continue
                 current_dp = _resolved_dp(
                     {**cfg_dict, **overrides}, extra
                 )
@@ -277,15 +520,29 @@ def main() -> int:
             else:
                 stalled = 0
                 if promote_stack:
-                    # Re-promotion probe: the degraded mesh just completed
-                    # a phase with real progress — try one step back up;
-                    # a re-hang degrades again, on budget.
+                    # Re-promotion probe: the degraded mesh/fleet just
+                    # completed a phase with real progress — try one step
+                    # back up; a re-hang degrades again, on budget.
                     restored = promote_stack.pop()
-                    overrides["data_parallel_devices"] = restored
-                    write_patched()
-                    _audit_row(exp_name, f"probe-promote:dp{restored}")
-                    print(f"--- {cfg}: clean degraded phase; probing "
-                          f"re-promotion to dp{restored}", flush=True)
+                    if fleet:
+                        current_procs = restored
+                        overrides["data_parallel_devices"] = (
+                            restored * local_devices
+                        )
+                        write_patched()
+                        _audit_row(
+                            exp_name, f"probe-promote:procs{restored}",
+                            process_count=restored,
+                        )
+                        print(f"--- {cfg}: clean degraded phase; probing "
+                              f"re-promotion to {restored} process(es)",
+                              flush=True)
+                    else:
+                        overrides["data_parallel_devices"] = restored
+                        write_patched()
+                        _audit_row(exp_name, f"probe-promote:dp{restored}")
+                        print(f"--- {cfg}: clean degraded phase; probing "
+                              f"re-promotion to dp{restored}", flush=True)
         if hangs >= max_hangs:
             print(f"--- {cfg}: hang budget ({max_hangs}) exhausted, "
                   "aborting", flush=True)
