@@ -38,6 +38,45 @@ from __graft_entry__ import _episode_batch, _flagship_config
 
 BASELINE_META_ITERS_PER_S = 0.55
 
+#: The DECLARED key surface of the one-JSON-line emission — a pure tuple
+#: literal so ``tools/bench_judge.py`` can read it by AST parse (no jax
+#: import) and cross-check ``tools/bench_gates.json`` coverage at review
+#: time: a gate for a key bench no longer emits is STALE, an emitted key
+#: with no gate entry is UNGATED — both are listed by the judge before any
+#: TPU run happens. ``main()`` verifies its actual payload against this
+#: tuple and self-reports drift on stderr, so the list cannot silently rot
+#: either direction.
+EMITTED_KEYS = (
+    "metric", "value", "unit", "vs_baseline",
+    "peak_meta_iters_per_s", "sustained_meta_iters_per_s", "mfu",
+    "bf16_meta_iters_per_s", "f32_wire_meta_iters_per_s",
+    "real_data_meta_iters_per_s", "real_data_vs_baseline",
+    "real_data_k25_meta_iters_per_s",
+    "real_data_data_wait_frac", "real_data_stage_wait_frac",
+    "k1_meta_iters_per_s", "dispatch_overhead_ms",
+    "imagenet_shape_meta_iters_per_s", "imagenet_shape_mfu",
+    "imagenet_shape_fused_train_meta_iters_per_s",
+    "imagenet_shape_fused_train_pool_meta_iters_per_s",
+    "imagenet_shape_lane_pad_meta_iters_per_s",
+    "imagenet_shape_bf16_meta_iters_per_s",
+    "imagenet_shape_task_chunk_meta_iters_per_s",
+    "imagenet_shape_all_levers_meta_iters_per_s",
+    "multichip_meta_iters_per_s", "multichip_scaling_efficiency",
+    "multichip_program", "multichip_rows", "multichip_fallback_reason",
+    "multichip_skipped_reason",
+    "multihost_meta_iters_per_s", "multihost_scaling_efficiency",
+    "multihost_maml_scaling_efficiency",
+    "multihost_maml_efficiency_limited_by", "multihost_program",
+    "multihost_rows", "multihost_fallback_reason",
+    "multihost_batch_bitexact", "multihost_skipped_reason",
+    "multihost_recovery_s",
+    "telemetry_overhead_pct",
+    "checkpoint_stall_sync_ms", "checkpoint_stall_async_ms",
+    "train_recovery_s",
+    "sentinel_before_ms", "sentinel_after_ms", "quiet_sentinel_norm_ms",
+    "live_trainer_pids", "contended",
+)
+
 # Multi-chip scale-out measurement (ISSUE 8): per-device-count dp-sharded
 # rates + scaling efficiency. Weak scaling: the per-device task load is
 # fixed and the global meta-batch grows with the mesh, so ideal scaling
@@ -1255,8 +1294,7 @@ def main() -> None:
         or (hi >= 3.0 * lo and hi > 2.0 * quiet_norm_ms)
     )
 
-    print(
-        json.dumps(
+    payload = (
             {
                 "metric": "maml++_omniglot_5w1s_meta_iters_per_s",
                 "value": round(value, 4),
@@ -1366,8 +1404,21 @@ def main() -> None:
                 "live_trainer_pids": live_trainers,
                 "contended": contended,
             }
-        )
     )
+    # Key-drift self-report (the judge's stale-key detector reads
+    # EMITTED_KEYS; a payload that disagrees with the declaration must say
+    # so on the very emission a reviewer reads).
+    declared = set(EMITTED_KEYS)
+    actual = set(payload)
+    for key in sorted(declared - actual):
+        print(f"# WARNING: EMITTED_KEYS declares {key!r} but this emission "
+              "lacks it (update bench.EMITTED_KEYS + tools/bench_gates.json)",
+              file=sys.stderr)
+    for key in sorted(actual - declared):
+        print(f"# WARNING: emission carries undeclared key {key!r} "
+              "(update bench.EMITTED_KEYS + tools/bench_gates.json)",
+              file=sys.stderr)
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -246,6 +246,11 @@ class DevicePrefetcher:
         telemetry_events.emit(
             "data_fault",
             iter=int(first_iter),
+            # Cross-rank join key: the first planned iteration of the
+            # group that failed to stage — correlates with the consumer's
+            # step/hang events for the same window in the fleet timeline
+            # (the run trace_id rides in via the global event context).
+            dispatch_id=int(first_iter),
             error=f"{type(exc).__name__}: {exc}"[:300],
             quarantined=self.faults_quarantined,
             budget=self._fault_budget,

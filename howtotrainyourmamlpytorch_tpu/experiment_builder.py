@@ -261,6 +261,13 @@ class ExperimentBuilder:
                 getattr(args, "profile_trigger_path", "") or ""
             ),
         )
+        # Live introspection: the heartbeat (logs/status.json, atomic
+        # tmp+rename at the existing forced-read boundaries) carries
+        # last-known progress + the fields only the builder knows —
+        # epoch, checkpoint age, watchdog state. The dispatcher reads it
+        # to enrich interruptions.csv audit rows instead of inferring
+        # everything from exit codes.
+        self.telemetry.heartbeat_extra = self._heartbeat_extra
         # Training-side resilience layer (the serve-path design of PR 6
         # mirrored onto the train path):
         # * dispatch hang watchdog (utils/watchdog.py): armed around every
@@ -775,6 +782,22 @@ class ExperimentBuilder:
     # Observability (delegated to telemetry/ — see TrainTelemetry)
     # ------------------------------------------------------------------
 
+    def _heartbeat_extra(self) -> dict:
+        """Builder-owned heartbeat fields (host scalars only — the
+        heartbeat rides forced-read boundaries and must never add a
+        sync): progress, checkpoint recency, watchdog state."""
+        extra = {
+            "epoch": int(self.epoch),
+            "best_val_acc": float(self.state.get("best_val_acc", 0.0) or 0.0),
+            "last_checkpoint_age_s": round(
+                time.monotonic() - self._last_ckpt_t, 3
+            ),
+            "shutdown_pending": self._shutdown_signum is not None,
+        }
+        if self._watchdog is not None:
+            extra["watchdog"] = self._watchdog.state()
+        return extra
+
     def _record_dispatch(self, n_iters: int = 1, upto_iter: int = 0) -> None:
         """One completed device dispatch ending at ``upto_iter``: samples
         the host-wait split and hands it to the telemetry recorder. With
@@ -973,6 +996,7 @@ class ExperimentBuilder:
             self.telemetry.event(
                 "checkpoint_submit",
                 path=os.path.basename(epoch_path),
+                iter=int(self.state["current_iter"]),
                 stall_s=time.perf_counter() - t0,
                 pending=self._ckpt_writer.pending,
             )

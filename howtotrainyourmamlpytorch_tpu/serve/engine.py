@@ -174,6 +174,12 @@ class ServingEngine:
         # attribute throughput to topology). A future sharded-serving
         # engine must raise this with its mesh size.
         self._n_devices = 1
+        # Fleet trace correlation: join the surrounding run's trace (the
+        # dispatcher exports MAML_TRACE_ID to every child) or start one,
+        # and number every device dispatch so serve_dispatch events line
+        # up across replicas in tools/telemetry_report.py --fleet.
+        self.trace_id = telemetry_events.ensure_trace_id()
+        self._dispatch_seq = 0
         self._adapt, self._classify = self._build_programs()
 
     # ------------------------------------------------------------------
@@ -411,8 +417,12 @@ class ServingEngine:
         self.metrics.episodes_served.inc(len(eps))
         self._note_bucket(eps[0].bucket)
         self.ready = True
+        with self._compiles_lock:
+            self._dispatch_seq += 1
+            dispatch_id = self._dispatch_seq
         telemetry_events.emit(
             "serve_dispatch",
+            dispatch_id=dispatch_id,
             bucket="x".join(str(d) for d in eps[0].bucket),
             episodes=len(eps),
             cache_hits=len(eps) - len(miss),

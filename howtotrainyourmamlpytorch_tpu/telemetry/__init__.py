@@ -15,14 +15,28 @@ hardware allows" + "serves heavy traffic" are claims that need receipts):
   new host syncs and zero recompiles (pinned under ``compile_guard``).
 * ``profiling`` — on-demand bounded ``jax.profiler`` captures mid-run via
   file trigger or ``SIGUSR1``, generalizing the first-N-iters-only flag.
+* ``heartbeat`` — live introspection: ``logs/status.json`` atomically
+  refreshed at forced-read boundaries (progress, windowed rate, wait
+  fractions, topology, checkpoint age, watchdog state); the dispatcher
+  reads it to enrich interruption audit rows.
+* ``anomaly`` — rolling step-time/data-wait detector judged against the
+  run's OWN p95 window, emitting typed ``anomaly`` events.
 * ``runtime`` — ``TrainTelemetry``, the builder-facing composition root.
 
+Cross-rank correlation: every event carries the run-scoped ``trace_id``
+(process-global context — one id per dispatcher run, shared by all fleet
+ranks) and dispatch-correlated events carry a ``dispatch_id`` join key.
+
 Reporting: ``tools/telemetry_report.py`` renders a run's JSONL into a
-step-time breakdown table, compile timeline and event log, and measures
-the ``telemetry_overhead_pct`` bench key (PERF_NOTES.md protocol).
+step-time breakdown table, compile timeline and event log; ``--fleet``
+merges N ranks' streams into one timeline with per-rank lanes and
+slowest-rank attribution; ``--overhead-bench`` measures the
+``telemetry_overhead_pct`` bench key (PERF_NOTES.md protocol).
 """
 
-from .events import SCHEMA_VERSION, EventLog, read_events
+from .anomaly import RollingAnomalyDetector
+from .events import SCHEMA_VERSION, EventLog, EventReader, read_events
+from .heartbeat import HeartbeatWriter, heartbeat_path, read_heartbeat
 from .profiling import ProfilerController
 from .registry import Counter, Gauge, LatencyStat, MetricsRegistry
 from .runtime import TrainTelemetry
@@ -30,7 +44,12 @@ from .runtime import TrainTelemetry
 __all__ = [
     "SCHEMA_VERSION",
     "EventLog",
+    "EventReader",
     "read_events",
+    "RollingAnomalyDetector",
+    "HeartbeatWriter",
+    "heartbeat_path",
+    "read_heartbeat",
     "ProfilerController",
     "Counter",
     "Gauge",

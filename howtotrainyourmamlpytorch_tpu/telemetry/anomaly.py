@@ -1,0 +1,115 @@
+"""Rolling step-time / data-wait anomaly detection for the train loop.
+
+The watchdog (``utils/watchdog.py``) catches the terminal case — a dispatch
+that never returns — but a run can rot far below that deadline: a straggling
+device, a co-tenant stealing the host core, a loader slowly falling behind.
+The detector turns those into typed ``anomaly`` telemetry events the moment
+they happen, judged against the RUN'S OWN recent distribution rather than
+any absolute threshold (a 132 µs flagship step and a 15 ms north-star step
+need the same rule, not the same number).
+
+Mechanics (all pure host arithmetic — no device reads, no I/O, safe on the
+hot path where ``TrainTelemetry.record_dispatch`` already runs):
+
+* a bounded rolling window of recent per-iteration samples per kind
+  (``step_time``, ``data_wait``, ``stage_wait``);
+* a sample is anomalous when it exceeds ``factor × p95(window)`` AND
+  ``p95 + min_delta_s`` — the relative test scales with the program, the
+  absolute floor keeps µs-scale jitter from firing on fast programs;
+* detection starts only after ``warmup`` samples (the compile-bearing
+  first dispatches must neither fire nor poison the window — the same
+  exclusion the watchdog deadline applies);
+* an anomalous sample is NOT fed back into the window (one hang must not
+  inflate p95 and mask the next one), and total emissions are capped so a
+  pathological run cannot flood the JSONL.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+#: Rolling-window length (samples) the p95 is computed over.
+DEFAULT_WINDOW = 128
+
+#: Samples required before detection arms (compile + cold-start exclusion).
+DEFAULT_WARMUP = 16
+
+#: Relative threshold: a sample beyond this multiple of the window p95.
+DEFAULT_FACTOR = 3.0
+
+#: Absolute floor added to the p95 before a sample can fire — µs-scale
+#: jitter on a fast program is noise, not an anomaly.
+DEFAULT_MIN_DELTA_S = 0.05
+
+#: Hard cap on anomalies reported per detector (JSONL flood guard).
+DEFAULT_MAX_REPORTS = 100
+
+
+class RollingAnomalyDetector:
+    """Per-kind rolling windows + the threshold rule above."""
+
+    def __init__(
+        self,
+        window: int = DEFAULT_WINDOW,
+        warmup: int = DEFAULT_WARMUP,
+        factor: float = DEFAULT_FACTOR,
+        min_delta_s: float = DEFAULT_MIN_DELTA_S,
+        max_reports: int = DEFAULT_MAX_REPORTS,
+    ):
+        if warmup < 2:
+            raise ValueError(f"warmup must be >= 2, got {warmup}")
+        self.window = int(window)
+        self.warmup = int(warmup)
+        self.factor = float(factor)
+        self.min_delta_s = float(min_delta_s)
+        self.max_reports = int(max_reports)
+        self.reports = 0
+        self._windows: dict[str, deque[float]] = {}
+
+    def _p95(self, samples: deque[float]) -> float:
+        ordered = sorted(samples)
+        return ordered[min(int(0.95 * len(ordered)), len(ordered) - 1)]
+
+    def observe(self, kind: str, value_s: float) -> dict | None:
+        """Feeds one per-iteration sample; returns the anomaly payload when
+        it fires (caller emits the typed event), else ``None``."""
+        value_s = float(value_s)
+        samples = self._windows.get(kind)
+        if samples is None:
+            samples = self._windows[kind] = deque(maxlen=self.window)
+        if len(samples) >= self.warmup:
+            p95 = self._p95(samples)
+            if (
+                value_s > self.factor * p95
+                and value_s > p95 + self.min_delta_s
+            ):
+                self.reports += 1
+                payload = None
+                if self.reports <= self.max_reports:
+                    payload = {
+                        "kind": kind,
+                        "value_s": value_s,
+                        "window_p95_s": p95,
+                        "factor": round(value_s / p95, 2) if p95 > 0 else None,
+                        "threshold_factor": self.factor,
+                        "window": len(samples),
+                    }
+                # The outlier never joins the window: one hang must not
+                # raise the p95 and mask its successors.
+                return payload
+        samples.append(value_s)
+        return None
+
+    def window_stats(self, kind: str) -> dict | None:
+        """Host-side summary of one kind's current window — the heartbeat's
+        "windowed" figures read exactly this."""
+        samples = self._windows.get(kind)
+        if not samples:
+            return None
+        total = sum(samples)
+        return {
+            "count": len(samples),
+            "sum_s": total,
+            "mean_s": total / len(samples),
+            "p95_s": self._p95(samples),
+        }

@@ -19,6 +19,14 @@ dispatches and compiles. Exactly like ``utils/faultinject.py``, the hooks
 are one ``None``-check when nothing is installed, so library code pays
 nothing outside an instrumented run.
 
+Cross-rank correlation (the fleet observability plane): a process-global
+CONTEXT (``set_context``) — today the run-scoped ``trace_id`` — is merged
+into every event at emit time, whichever thread emits it (builder loop,
+stager, async checkpoint writer, watchdog monitor). Every rank of a fleet
+carries the SAME trace_id (the dispatcher exports :data:`TRACE_ID_ENV` to
+all ranks of a phase), so N ranks' JSONL streams merge into one attributed
+timeline in ``tools/telemetry_report.py --fleet``.
+
 Non-finite floats are serialized as ``null`` (strict JSON; ``NaN`` literals
 would break non-Python consumers of the JSONL).
 """
@@ -27,13 +35,65 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 import threading
 import time
+import uuid
 
 #: Bump when the event-line layout changes incompatibly
 #: (``tools/telemetry_report.py`` refuses newer schemas).
 SCHEMA_VERSION = 1
+
+#: Environment variable carrying the run-scoped trace id into child
+#: processes: the dispatcher sets it once per supervised run, so every
+#: phase — and every rank of a fleet phase — stamps the same trace_id.
+TRACE_ID_ENV = "MAML_TRACE_ID"
+
+
+def new_trace_id() -> str:
+    """A fresh run-scoped trace id (short hex — it rides on every event)."""
+    return uuid.uuid4().hex[:16]
+
+
+# ---------------------------------------------------------------------------
+# Process-global event context (trace correlation)
+# ---------------------------------------------------------------------------
+
+_context: dict = {}
+
+
+def set_context(**fields) -> dict:
+    """Replaces the process-global context merged into every emitted event
+    (``trace_id`` today); returns the PREVIOUS context so callers can
+    restore it (nesting-safe, like ``install``). Explicit event fields win
+    over context fields."""
+    global _context
+    previous = _context
+    _context = {key: value for key, value in fields.items() if value is not None}
+    return previous
+
+
+def restore_context(previous: dict) -> None:
+    global _context
+    _context = dict(previous)
+
+
+def get_context() -> dict:
+    return dict(_context)
+
+
+def ensure_trace_id() -> str:
+    """Returns the context trace_id, installing one first if absent — from
+    :data:`TRACE_ID_ENV` when the parent (dispatcher / fleet supervisor)
+    exported it, else freshly generated. Lets standalone emitters (the
+    serving engine, tools) join the surrounding run's trace without owning
+    a ``TrainTelemetry``."""
+    trace_id = _context.get("trace_id")
+    if not trace_id:
+        trace_id = os.environ.get(TRACE_ID_ENV) or new_trace_id()
+        _context["trace_id"] = trace_id
+    return str(trace_id)
 
 
 def _jsonable(value):
@@ -69,8 +129,14 @@ class EventLog:
         self._serialize_failures = 0
 
     def emit(self, event_type: str, **fields) -> None:
-        """Buffers one event. No I/O — see the module contract."""
+        """Buffers one event. No I/O — see the module contract. The
+        process-global context (``set_context`` — the run's trace_id) is
+        merged in here, so every emitter thread (builder, stager, async
+        writer, watchdog monitor) stamps the same correlation fields;
+        explicit fields win."""
         record = {"t": self._clock(), "type": str(event_type)}
+        for key, value in _context.items():
+            record[key] = value
         for key, value in fields.items():
             record[key] = _jsonable(value)
         with self._lock:
@@ -146,40 +212,108 @@ class EventLog:
         self.flush()
 
 
-def read_events(path: str) -> list[dict]:
-    """Parses a telemetry JSONL file back into event dicts (blank lines
-    skipped). Raises ``ValueError`` on a schema line newer than this
-    build understands — refuse to misread rather than silently drop.
-    Unparseable lines are skipped with a stderr warning: on multi-host
-    runs every rank appends to the shared JSONL, and a rare torn line
-    from concurrent appends must not make the whole stream unreadable."""
-    events = []
-    torn = 0
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                record = json.loads(line)
-            except ValueError:
-                torn += 1
-                continue
-            if record.get("type") == "schema":
-                version = int(record.get("version", -1))
-                if version > SCHEMA_VERSION:
-                    raise ValueError(
-                        f"{path}: telemetry schema {version} is newer than "
-                        f"this build reads (up to {SCHEMA_VERSION})"
-                    )
-            events.append(record)
-    if torn:
-        print(
-            f"WARNING: skipped {torn} unparseable line(s) in {path} "
-            "(concurrent multi-rank appends can tear a line)",
-            file=sys.stderr,
-        )
-    return events
+class EventReader:
+    """Offset-aware streaming reader over a telemetry JSONL file.
+
+    Built for the two consumers plain ``read_events`` could not serve:
+
+    * **fleet reports over long runs** — ``tools/telemetry_report.py
+      --fleet`` iterates events line-by-line instead of slurping a
+      multi-GB JSONL into one list-of-everything per rank;
+    * **incremental tailing** — ``read(since=...)`` resumes from the byte
+      ``offset`` of the previous call, so a live supervisor can follow a
+      run's stream without re-parsing history.
+
+    Torn-line tolerance (the PR 11 contract, regression-pinned through
+    this path): a malformed line MID-file is skipped with a stderr
+    warning (concurrent multi-rank appends can tear a line); an
+    INCOMPLETE final line (no trailing newline yet — a writer mid-append)
+    is NOT consumed, so the next ``read`` resumes exactly there once the
+    writer finishes it. ``read`` raises ``ValueError`` on a schema line
+    newer than this build understands — refuse to misread rather than
+    silently drop."""
+
+    def __init__(self, path: str, offset: int = 0):
+        self.path = path
+        self.offset = int(offset)
+        self.torn_lines = 0
+
+    def _parse(self, line: bytes, since: float | None) -> dict | None:
+        """One line -> event dict, or None (torn / filtered). Schema lines
+        always pass the ``since`` filter (the version refusal must not
+        depend on the window) and refuse newer versions."""
+        try:
+            record = json.loads(line)
+        except ValueError:
+            self.torn_lines += 1
+            return None
+        if record.get("type") == "schema":
+            version = int(record.get("version", -1))
+            if version > SCHEMA_VERSION:
+                raise ValueError(
+                    f"{self.path}: telemetry schema {version} is newer "
+                    f"than this build reads (up to {SCHEMA_VERSION})"
+                )
+        elif since is not None and float(record.get("t", 0.0)) < since:
+            return None
+        return record
+
+    def iter_events(self, since: float | None = None,
+                    include_tail: bool = False):
+        """Yields event dicts from byte ``offset`` onward, advancing
+        ``offset`` past each fully-terminated line as it parses.
+
+        ``include_tail`` covers the one-shot post-mortem read: a final
+        line with no trailing newline yet is parsed and yielded IF it is
+        complete JSON (a run killed mid-``write`` can land exactly through
+        the closing brace — the event explaining the death must not be
+        dropped), but the offset never advances past it, so a follow-up
+        incremental ``read`` re-checks it once the writer finishes."""
+        torn_before = self.torn_lines
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            tail = b""
+            for raw in f:
+                if not raw.endswith(b"\n"):
+                    tail = raw  # writer mid-append: never consumed here
+                    break
+                self.offset += len(raw)
+                line = raw.strip()
+                if not line:
+                    continue
+                record = self._parse(line, since)
+                if record is not None:
+                    yield record
+        if include_tail and tail.strip():
+            torn_seen = self.torn_lines
+            record = self._parse(tail.strip(), since)
+            if record is not None:
+                yield record
+            else:
+                # An incomplete tail is a writer mid-append, not a torn
+                # line — don't count or warn about it.
+                self.torn_lines = torn_seen
+        torn = self.torn_lines - torn_before
+        if torn:
+            print(
+                f"WARNING: skipped {torn} unparseable line(s) in "
+                f"{self.path} (concurrent multi-rank appends can tear a "
+                "line)",
+                file=sys.stderr,
+            )
+
+    def read(self, since: float | None = None,
+             include_tail: bool = False) -> list[dict]:
+        return list(self.iter_events(since=since, include_tail=include_tail))
+
+
+def read_events(path: str, since: float | None = None) -> list[dict]:
+    """Parses a telemetry JSONL file back into event dicts — the one-shot
+    form of :class:`EventReader` (same torn-line tolerance and same
+    newer-schema refusal, through the same streaming path), including a
+    complete-but-unterminated final line (a killed writer's last event).
+    ``since`` drops events stamped before that unix time."""
+    return EventReader(path).read(since=since, include_tail=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,97 @@
+"""Live trainer introspection: the ``logs/status.json`` heartbeat.
+
+A training process is opaque between log lines: a supervisor (the
+dispatcher, an operator, a dashboard) that wants "where is this run and is
+it healthy?" has to scrape stdout or tail the JSONL. The heartbeat is the
+mechanical answer — one small JSON document, atomically replaced at the
+loop's existing forced-read boundaries (the ``TRAIN_LOG_EVERY`` cadence and
+epoch summaries), carrying last-known progress (epoch/iter), the windowed
+meta-iters/s, the data/stage-wait fractions, mesh topology, checkpoint age
+and watchdog state.
+
+Contracts:
+
+* **Zero new syncs.** The writer is only ever called from boundaries that
+  already force a device read; the payload is host scalars the telemetry
+  recorder already holds.
+* **Atomic.** ``write_heartbeat`` writes a pid-unique tmp file and
+  ``os.replace``s it over the target, so a reader can NEVER observe a torn
+  document — a SIGKILL mid-write leaves the previous heartbeat intact (at
+  worst plus one orphaned tmp).
+* **Never crashes the run.** I/O failure degrades to a dropped beat and a
+  once-per-run stderr warning, exactly like the event log's flush.
+* **Per-rank on fleets.** Multi-host ranks share one logs dir; rank 0 owns
+  ``status.json`` (what the dispatcher reads) and rank k writes
+  ``status.r<k>.json`` — two ranks must not race one rename target.
+
+``train_maml_system_dispatch.py`` reads the heartbeat to enrich its
+``interruptions.csv`` audit rows with last-known progress instead of
+inferring everything from exit codes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+#: Bump when the heartbeat document layout changes incompatibly.
+HEARTBEAT_SCHEMA = 1
+
+
+def heartbeat_path(logs_dir: str, process_index: int = 0) -> str:
+    """Rank 0 -> ``status.json`` (the supervisor-facing file); rank k ->
+    ``status.r<k>.json`` (fleet ranks share the logs dir and must not race
+    one rename target)."""
+    name = (
+        "status.json" if process_index == 0 else f"status.r{process_index}.json"
+    )
+    return os.path.join(logs_dir, name)
+
+
+class HeartbeatWriter:
+    """Atomic tmp+rename writer for one run's heartbeat file."""
+
+    def __init__(self, path: str, clock=time.time):
+        self.path = path
+        self._clock = clock
+        self._tmp = f"{path}.tmp.{os.getpid()}"
+        self._write_failures = 0
+
+    def write(self, payload: dict) -> bool:
+        """Atomically replaces the heartbeat with ``payload`` (plus the
+        ``schema``/``t`` stamps). Returns False (after a once-per-run
+        warning) instead of raising on I/O failure — introspection must
+        never kill the run it introspects."""
+        doc = {"schema": HEARTBEAT_SCHEMA, "t": self._clock(), **payload}
+        try:
+            with open(self._tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(self._tmp, self.path)
+        except (OSError, TypeError, ValueError) as exc:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+            self._write_failures += 1
+            if self._write_failures == 1:
+                print(
+                    f"WARNING: heartbeat write to {self.path} failed "
+                    f"({exc}); training continues, introspection degrades",
+                    file=sys.stderr,
+                )
+            return False
+        return True
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """Tolerant heartbeat read: ``None`` when the file is absent or
+    unparseable (a pre-heartbeat experiment, a dead tmp, a foreign file) —
+    consumers fall back to exit-code inference, they never crash."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None

@@ -18,9 +18,14 @@ Owns the per-run :class:`~.events.EventLog` (``logs/telemetry.jsonl``), a
   summary CSV (a slow loader is now distinguishable from a slow device),
   plus an ``epoch_summary`` event carrying the registry snapshot.
 * ``activate`` — context manager installing the process-global event sink,
-  the XLA compile-event bridge (``utils/sanitize.compile_listener``), and
-  the ``SIGUSR1`` profile trigger; ``shutdown`` (idempotent) stops the
+  the run-scoped ``trace_id`` event context (every emitter thread stamps
+  it), the XLA compile-event bridge (``utils/sanitize.compile_listener``),
+  and the ``SIGUSR1`` profile trigger; ``shutdown`` (idempotent) stops the
   profiler and flushes from EVERY exit path, including preemption-requeue.
+* ``write_heartbeat`` — the live-introspection beat (``logs/status.json``,
+  telemetry/heartbeat.py), refreshed from ``boundary`` only; the rolling
+  anomaly detector (telemetry/anomaly.py) rides ``record_dispatch`` and
+  emits typed ``anomaly`` events — both pure host work, zero new syncs.
 """
 
 from __future__ import annotations
@@ -35,7 +40,9 @@ import numpy as np
 
 from ..utils.sanitize import compile_listener
 from . import events as telemetry_events
+from .anomaly import RollingAnomalyDetector
 from .events import EventLog
+from .heartbeat import HeartbeatWriter, heartbeat_path
 from .profiling import ProfilerController
 from .registry import MetricsRegistry
 
@@ -62,9 +69,20 @@ class TrainTelemetry:
         mesh_mp: int = 1,
         process_index: int = 0,
         process_count: int = 1,
+        trace_id: str | None = None,
     ):
         self.enabled = bool(enabled)
         self.logs_dir = logs_dir
+        # Run-scoped trace id (cross-rank correlation): an explicit value
+        # wins, then the dispatcher-exported env (every rank of a fleet
+        # phase inherits the SAME id), then a fresh one. Stamped on every
+        # event via the process-global context while activated — whichever
+        # thread emits (builder, stager, async writer, watchdog monitor).
+        self.trace_id = str(
+            trace_id
+            or os.environ.get(telemetry_events.TRACE_ID_ENV)
+            or telemetry_events.new_trace_id()
+        )
         # Mesh attribution (multi-chip runs): stamped on every step event
         # and the per-epoch summary keys, so a throughput regression is
         # attributable to a topology change from the telemetry alone. The
@@ -104,6 +122,25 @@ class TrainTelemetry:
         self._data_waits: list[float] = []
         self._stage_waits: list[float] = []
         self._ended = False
+        # Live introspection (the observability-plane heartbeat): a small
+        # status.json atomically replaced at the existing forced-read
+        # boundaries, plus a rolling anomaly detector judging each
+        # dispatch against the run's own recent p95. Both are pure host
+        # work on scalars the recorder already holds — zero new syncs.
+        self.anomaly = RollingAnomalyDetector()
+        self._heartbeat: HeartbeatWriter | None = (
+            HeartbeatWriter(
+                heartbeat_path(logs_dir, process_index=self.process_index)
+            )
+            if self.enabled
+            else None
+        )
+        #: Owner-supplied extra heartbeat fields (epoch, checkpoint age,
+        #: watchdog state — things only the builder knows), merged into
+        #: every beat. Set once by ``ExperimentBuilder``; must be cheap and
+        #: must not touch the device.
+        self.heartbeat_extra = None
+        self._epoch = None
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -120,7 +157,18 @@ class TrainTelemetry:
                 self.profiler.stop()
             return
         previous_sink = telemetry_events.install(self.events)
-        self.events.emit("run_start", pid=os.getpid())
+        # Context = trace id + host identity: deep emitters that know
+        # neither (the stager's data_fault, the async writer's checkpoint
+        # events) still stamp both, so a fleet merge attributes them to
+        # the rank that saw them. Explicit event fields win over context.
+        previous_context = telemetry_events.set_context(
+            trace_id=self.trace_id,
+            process_index=self.process_index,
+            process_count=self.process_count,
+        )
+        self.events.emit("run_start", pid=os.getpid(),
+                         process_index=self.process_index,
+                         process_count=self.process_count)
         previous_usr1 = self._install_usr1()
         try:
             with compile_listener(self._on_compile):
@@ -132,6 +180,7 @@ class TrainTelemetry:
                     signal.signal(signal.SIGUSR1, previous_usr1)
                 except (ValueError, OSError):
                     pass
+            telemetry_events.restore_context(previous_context)
             telemetry_events.install(previous_sink)
 
     def _install_usr1(self):
@@ -154,7 +203,7 @@ class TrainTelemetry:
         if self.events is not None:
             if not self._ended:
                 self._ended = True
-                self.events.emit("run_end")
+                self.event("run_end")
             self.events.flush()
 
     # ------------------------------------------------------------------
@@ -213,6 +262,12 @@ class TrainTelemetry:
                 self.events.emit(
                     "step",
                     iter=int(upto_iter),
+                    # Cross-rank join key: the iteration the dispatch ended
+                    # at. Every rank of a lockstep fleet dispatches the same
+                    # iteration windows, so equal dispatch_ids ARE the same
+                    # logical dispatch — the fleet report's slowest-rank
+                    # attribution groups on it.
+                    dispatch_id=int(upto_iter),
                     k=int(n_iters),
                     step_s=total_s,
                     data_wait_s=data_wait_s,
@@ -224,8 +279,31 @@ class TrainTelemetry:
                     process_index=self.process_index,
                     process_count=self.process_count,
                 )
+            # Anomaly detection: each per-iteration sample judged against
+            # the run's own rolling p95 (pure host arithmetic; the typed
+            # event is a buffered append — still zero new syncs).
+            self._observe_anomaly("step_time", total_s / n_iters, upto_iter)
+            self._observe_anomaly(
+                "data_wait", data_wait_s / n_iters, upto_iter
+            )
+            self._observe_anomaly(
+                "stage_wait", stage_wait_s / n_iters, upto_iter
+            )
         self._last_dispatch_t = now
         self.profiler.tick(n_iters)
+
+    def _observe_anomaly(
+        self, kind: str, value_s: float, upto_iter: int
+    ) -> None:
+        fired = self.anomaly.observe(kind, value_s)
+        if fired is not None:
+            self.registry.counter("anomalies").inc()
+            self.event(
+                "anomaly",
+                iter=int(upto_iter),
+                dispatch_id=int(upto_iter),
+                **fired,
+            )
 
     # ------------------------------------------------------------------
     # Forced-read boundaries (the only I/O points)
@@ -234,15 +312,59 @@ class TrainTelemetry:
     def boundary(self, current_iter: int, sync_s: float, reason: str) -> None:
         """A point that already forced a device read (log cadence, epoch
         summary): record its host-sync cost, poll the profiler file
-        trigger, flush buffered events."""
+        trigger, flush buffered events, and refresh the heartbeat (the
+        only places the status file is touched — introspection rides the
+        syncs the loop already pays)."""
         self.registry.window("host_sync_ms").observe(1e3 * sync_s)
-        if self.events is not None:
-            self.events.emit(
-                "host_sync", iter=int(current_iter), sync_s=sync_s,
-                reason=reason,
-            )
+        self.event(
+            "host_sync", iter=int(current_iter), sync_s=sync_s,
+            reason=reason,
+        )
         self.profiler.poll_trigger()
         self.flush()
+        self.write_heartbeat(current_iter)
+
+    def write_heartbeat(self, current_iter: int) -> None:
+        """Atomically refreshes ``logs/status.json`` with last-known
+        progress + the telemetry windows (see telemetry/heartbeat.py).
+        Only called from forced-read boundaries; all fields are host
+        scalars already in hand."""
+        if self._heartbeat is None:
+            return
+        payload = {
+            "trace_id": self.trace_id,
+            "pid": os.getpid(),
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "n_devices": self.n_devices,
+            "mesh_dp": self.mesh_dp,
+            "mesh_mp": self.mesh_mp,
+            "current_iter": int(current_iter),
+            "epoch": self._epoch,
+            "anomalies": self.anomaly.reports,
+        }
+        steps = self.anomaly.window_stats("step_time")
+        if steps is not None and steps["sum_s"] > 0:
+            payload["meta_iters_per_s"] = round(
+                steps["count"] / steps["sum_s"], 4
+            )
+            payload["step_time_p95_s"] = round(steps["p95_s"], 6)
+            for kind in ("data_wait", "stage_wait"):
+                waits = self.anomaly.window_stats(kind)
+                if waits is not None:
+                    payload[f"{kind}_frac"] = round(
+                        waits["sum_s"] / steps["sum_s"], 6
+                    )
+        if self.heartbeat_extra is not None:
+            try:
+                extra = self.heartbeat_extra()
+            except Exception:  # noqa: BLE001 — introspection must not kill
+                extra = None
+            if isinstance(extra, dict):
+                payload.update(extra)
+        if payload.get("epoch") is not None:
+            self._epoch = payload["epoch"]
+        self._heartbeat.write(payload)
 
     def epoch_stats(self, phase: str = "train", epoch: int | None = None) -> dict:
         """Pops the epoch's per-iteration samples into the summary-CSV keys
@@ -253,6 +375,8 @@ class TrainTelemetry:
         # Always drop the anchor at epoch end: the next epoch's first
         # dispatch must not measure the val-epoch + checkpoint gap.
         self._last_dispatch_t = None
+        if epoch is not None:
+            self._epoch = int(epoch)  # last-known progress for the heartbeat
         steps, self._step_times = self._step_times, []
         waits, self._data_waits = self._data_waits, []
         stage_waits, self._stage_waits = self._stage_waits, []

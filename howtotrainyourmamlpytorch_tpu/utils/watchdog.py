@@ -261,6 +261,11 @@ class DispatchWatchdog:
         telemetry_events.emit(  # pure in-memory append (events contract)
             "hang",
             iter=diag["iter"],
+            # Cross-rank join key (fleet observability): the hung dispatch
+            # correlates with the survivors' step events for the SAME
+            # iteration window — which rank wedged first reads straight
+            # off the merged timeline.
+            dispatch_id=diag["iter"],
             deadline_s=diag["deadline_s"],
             elapsed_s=diag["elapsed_s"],
             stack_path=stack_path,
@@ -304,6 +309,18 @@ class DispatchWatchdog:
                 self._on_hang(diag)
             except Exception:  # noqa: BLE001 — unwind must not block exit
                 traceback.print_exc()
+
+    def state(self) -> dict:
+        """Point-in-time snapshot for the trainer heartbeat: whether a
+        dispatch window is armed, its deadline, and whether the watchdog
+        ever fired. Pure in-memory read — safe from any thread."""
+        with self._cond:
+            return {
+                "armed": self._armed_at is not None,
+                "armed_iter": self._armed_iter,
+                "deadline_s": round(self._armed_deadline_s, 3),
+                "fired": self.fired,
+            }
 
     # ------------------------------------------------------------------
     # Lifecycle

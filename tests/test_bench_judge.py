@@ -1,0 +1,383 @@
+"""Mechanical bench keep/revert judge (tools/bench_judge.py).
+
+Two layers of pins:
+
+* **Unit**: synthetic trajectories exercising each verdict class — keep,
+  revert, regress, pending — plus contention-sentinel handling, baseline
+  selection across null-valued runs, the restricted gate-expression
+  grammar, and the stale-key detectors.
+* **Tier-1 regression gate** (the ISSUE 12 acceptance): the judge runs via
+  the real CLI over the checked-in ``BENCH_r01..r03`` trajectory — every
+  gated key classified, exit 0 (nothing regressed at HEAD) — and a
+  deliberately-degraded synthetic ``r04`` flips the headline key to
+  ``regress`` with a non-zero exit, so a perf claim can never rot
+  silently once a worse emission lands.
+
+Coverage pins keep the gate data honest: every ``bench.EMITTED_KEYS``
+entry is either gated or explicitly ``ungated_ok``; every bench-sourced
+gate key is still emitted; every gate entry naming a PERF_NOTES section
+actually appears in PERF_NOTES.md (prose and gate data cannot diverge
+silently).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import bench_judge
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_trajectory(tmp_path, runs):
+    """Writes synthetic emission files; returns their paths oldest-first.
+    Each run is a parsed-payload dict (the raw bench.py emission form)."""
+    paths = []
+    for i, parsed in enumerate(runs):
+        path = tmp_path / f"BENCH_t{i + 1:02d}.json"
+        path.write_text(json.dumps({"n": i + 1, "parsed": parsed}))
+        paths.append(str(path))
+    return paths
+
+
+def _gates(gates, ungated_ok=(), default_tolerance=0.08):
+    return {
+        "schema": 1,
+        "default_tolerance": default_tolerance,
+        "ungated_ok": list(ungated_ok),
+        "gates": gates,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Verdict classes
+# ---------------------------------------------------------------------------
+
+
+def test_keep_revert_pending_each_classified(tmp_path):
+    gates = _gates({
+        "rate": {"gate": None, "direction": "higher"},
+        "lever_rate": {"gate": "this >= 1.1 * rate", "direction": "higher"},
+        "bad_lever_rate": {"gate": "this >= 2.0 * rate",
+                           "direction": "higher"},
+        "unmeasured": {"gate": "this >= 0.5", "direction": "higher"},
+        "future_gate": {"gate": "this >= 1.0", "direction": "higher",
+                        "gate_from_run": 9},
+    })
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 100.0, "lever_rate": 130.0, "bad_lever_rate": 120.0,
+         "future_gate": 5.0},
+    ]))
+    result = bench_judge.judge(gates, runs)
+    v = result["verdicts"]
+    assert v["rate"]["verdict"] == "keep"          # no bar, tracked only
+    assert v["lever_rate"]["verdict"] == "keep"    # 130 >= 1.1 * 100
+    assert v["bad_lever_rate"]["verdict"] == "revert"  # 120 < 200
+    assert v["unmeasured"]["verdict"] == "pending"
+    # The pending-until-TPU marker: the lever shipped after this capture.
+    assert v["future_gate"]["verdict"] == "pending"
+    assert "run 9" in v["future_gate"]["reason"]
+    assert result["regressions"] == []
+    # Every gated key got exactly one verdict — no unclassified keys.
+    assert set(v) == set(gates["gates"])
+
+
+def test_regress_flips_on_degraded_run_and_dominates_gate(tmp_path):
+    gates = _gates({
+        "rate": {"gate": "this >= 1.0", "direction": "higher",
+                 "tolerance": 0.1},
+    })
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 100.0},
+        {"rate": 50.0},  # 50% drop >> 10% tolerance — but gate still holds
+    ]))
+    result = bench_judge.judge(gates, runs)
+    assert result["verdicts"]["rate"]["verdict"] == "regress"
+    assert result["verdicts"]["rate"]["prior"] == 100.0
+    assert result["regressions"] == ["rate"]
+
+
+def test_tolerance_absorbs_noise_and_lower_direction(tmp_path):
+    gates = _gates({
+        "rate": {"gate": None, "direction": "higher", "tolerance": 0.1},
+        "latency_ms": {"gate": None, "direction": "lower",
+                       "tolerance": 0.1},
+        "overhead_pct": {"gate": None, "direction": "lower",
+                         "tolerance": 0.5, "abs_slack": 1.0},
+    })
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 100.0, "latency_ms": 10.0, "overhead_pct": -0.2},
+        # rate -5% (inside 10%), latency +5% (inside), overhead crosses
+        # zero but stays inside the absolute slack that exists for
+        # near-zero keys (a pure relative tolerance on -0.2 would flag
+        # +0.3 as a regression).
+        {"rate": 95.0, "latency_ms": 10.5, "overhead_pct": 0.3},
+    ]))
+    result = bench_judge.judge(gates, runs)
+    assert result["regressions"] == []
+    runs2 = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"latency_ms": 10.0}, {"latency_ms": 20.0},
+    ]))
+    gates2 = _gates({"latency_ms": {"gate": None, "direction": "lower",
+                                    "tolerance": 0.1}})
+    assert bench_judge.judge(gates2, runs2)["regressions"] == ["latency_ms"]
+
+
+def test_contended_emission_is_never_baseline_nor_judged(tmp_path):
+    """The contention sentinel honored both ways: a contended latest run
+    is skipped (the previous accepted run stays the judged one — a
+    poisoned number can't manufacture a regression), and a contended
+    middle run never becomes the regression baseline."""
+    gates = _gates({"rate": {"gate": None, "direction": "higher",
+                             "tolerance": 0.1}})
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 100.0},
+        {"rate": 500.0, "contended": True},   # poisoned high reading
+        {"rate": 101.0},
+        {"rate": 10.0, "contended": True},    # poisoned low reading, latest
+    ]))
+    result = bench_judge.judge(gates, runs)
+    assert result["accepted_run"].endswith("t03.json")
+    assert set(result["skipped_contended"]) == {
+        "BENCH_t02.json", "BENCH_t04.json"
+    }
+    # Judged 101 vs prior 100 — neither poisoned reading participated.
+    assert result["verdicts"]["rate"]["verdict"] == "keep"
+    assert result["verdicts"]["rate"]["prior"] == 100.0
+    assert result["regressions"] == []
+
+
+def test_all_contended_trajectory_refuses(tmp_path):
+    gates = _gates({"rate": {"gate": None}})
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 1.0, "contended": True},
+    ]))
+    with pytest.raises(ValueError, match="contended"):
+        bench_judge.judge(gates, runs)
+
+
+def test_baseline_selection_skips_null_valued_runs(tmp_path):
+    """The regression baseline is the newest EARLIER accepted run that
+    actually measured the key — null/absent emissions (a skipped extra)
+    must not erase the history."""
+    gates = _gates({"rate": {"gate": None, "direction": "higher",
+                             "tolerance": 0.1}})
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 100.0},
+        {"rate": None},     # measurement skipped that round
+        {"rate": 80.0},     # vs 100 — a 20% regression
+    ]))
+    result = bench_judge.judge(gates, runs)
+    assert result["verdicts"]["rate"]["prior_run"] == "BENCH_t01.json"
+    assert result["verdicts"]["rate"]["verdict"] == "regress"
+
+
+def test_gate_expression_grammar_is_restricted():
+    assert bench_judge.eval_gate("this >= 0.5 * rate",
+                                 {"this": 60.0, "rate": 100.0}) is True
+    assert bench_judge.eval_gate("this >= 0.75 and this <= 1.0",
+                                 {"this": 0.8}) is True
+    assert bench_judge.eval_gate("this >= 1", {"this": True}) is True
+    # Unmeasured reference -> None (judges as pending, never as a pass).
+    assert bench_judge.eval_gate("this >= rate", {"this": 1.0}) is None
+    assert bench_judge.eval_gate("this >= rate",
+                                 {"this": 1.0, "rate": None}) is None
+    for bad in ("__import__('os')", "this.x > 1", "f(this)", "this >= 'a'"):
+        with pytest.raises(ValueError):
+            bench_judge.eval_gate(bad, {"this": 1.0})
+
+
+def test_stale_key_detection(tmp_path):
+    """The judge lists gate keys the emission lacks, gate keys bench no
+    longer declares, and emitted keys with neither a gate nor an
+    ungated_ok entry — bench key drift is a review-time finding."""
+    gates = _gates(
+        {
+            "rate": {"gate": None},
+            "ghost_key": {"gate": None, "source": "bench.py"},
+        },
+        ungated_ok=["meta"],
+    )
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"rate": 1.0, "meta": "x", "surprise_key": 2.0},
+    ]))
+    result = bench_judge.judge(gates, runs)
+    assert result["verdicts"]["ghost_key"]["verdict"] == "pending"
+    assert "ghost_key" in result["stale"]["missing_from_latest"]
+    # ghost_key is not in bench.EMITTED_KEYS -> a stale gate.
+    assert "ghost_key" in result["stale"]["stale_gates"]
+    assert "surprise_key" in result["stale"]["ungated_keys"]
+
+
+def test_raw_emission_payloads_load_too(tmp_path):
+    """A trajectory of raw one-line bench.py payloads (no driver wrapper)
+    judges identically — the judge must accept what the tool prints."""
+    path = tmp_path / "raw.json"
+    path.write_text(json.dumps({"rate": 5.0}))
+    runs = bench_judge.load_trajectory([str(path)])
+    assert runs[0]["parsed"]["rate"] == 5.0
+    assert runs[0]["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Gate-data coverage: bench.EMITTED_KEYS <-> bench_gates.json <-> PERF_NOTES
+# ---------------------------------------------------------------------------
+
+
+def test_every_bench_key_is_gated_or_explicitly_ungated():
+    emitted = bench_judge.bench_emitted_keys()
+    assert emitted, "bench.py lost its EMITTED_KEYS literal"
+    doc = bench_judge.load_gates(bench_judge.DEFAULT_GATES_PATH)
+    known = set(doc["gates"]) | set(doc["ungated_ok"])
+    uncovered = sorted(set(emitted) - known)
+    assert uncovered == [], (
+        f"bench keys with no gate and no ungated_ok entry: {uncovered} — "
+        "add them to tools/bench_gates.json"
+    )
+
+
+def test_no_stale_gates_at_head():
+    emitted = set(bench_judge.bench_emitted_keys() or ())
+    doc = bench_judge.load_gates(bench_judge.DEFAULT_GATES_PATH)
+    stale = sorted(
+        key for key, spec in doc["gates"].items()
+        if spec.get("source", "bench.py") == "bench.py"
+        and key not in emitted
+    )
+    assert stale == [], (
+        f"gates for keys bench.py no longer emits: {stale}"
+    )
+
+
+def test_checked_in_emissions_only_use_declared_keys():
+    """Every key of the newest checked-in emission is declared in
+    bench.EMITTED_KEYS — the declaration the judge's coverage checks hang
+    off really describes what the tool prints."""
+    emitted = set(bench_judge.bench_emitted_keys() or ())
+    with open(os.path.join(REPO, "BENCH_r03.json")) as f:
+        parsed = json.load(f)["parsed"]
+    undeclared = sorted(set(parsed) - emitted)
+    assert undeclared == [], undeclared
+
+
+def test_perf_notes_sections_name_their_gate_keys():
+    """Prose/gate coupling: a gate entry naming a PERF_NOTES section means
+    that section's keep/revert table cites the key — both the section
+    heading and the key string must exist in PERF_NOTES.md."""
+    doc = bench_judge.load_gates(bench_judge.DEFAULT_GATES_PATH)
+    with open(os.path.join(REPO, "PERF_NOTES.md")) as f:
+        notes = f.read()
+    for key, spec in doc["gates"].items():
+        section = spec.get("perf_notes")
+        if not section:
+            continue
+        assert section in notes, (
+            f"gate {key} cites PERF_NOTES section {section!r}, not found"
+        )
+        assert key in notes, (
+            f"gate key {key} is absent from PERF_NOTES.md — annotate the "
+            f"{section!r} keep/revert table with it"
+        )
+
+
+def test_every_gate_expression_parses():
+    doc = bench_judge.load_gates(bench_judge.DEFAULT_GATES_PATH)
+    for key, spec in doc["gates"].items():
+        expr = spec.get("gate")
+        if expr:
+            # Must parse under the restricted grammar; evaluation with
+            # an empty env must be None (pending), never an exception.
+            assert bench_judge.eval_gate(expr, {}) is None or isinstance(
+                bench_judge.eval_gate(expr, {}), bool
+            ), key
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 regression gate through the real CLI (the ISSUE 12 acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*argv, cwd=REPO):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.bench_judge", *argv],
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=120,
+    )
+
+
+def test_checked_in_trajectory_judges_clean_via_cli():
+    """THE tier-1 gate: the judge over BENCH_r01..r03 emits a verdict for
+    every gated key, finds no regression at HEAD, and exits 0. The day a
+    worse emission is checked in, this test fails — a perf claim cannot
+    silently rot."""
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout)
+    doc = bench_judge.load_gates(bench_judge.DEFAULT_GATES_PATH)
+    # Every gated key classified — no unclassified keys.
+    assert set(result["verdicts"]) == set(doc["gates"])
+    for key, entry in result["verdicts"].items():
+        assert entry["verdict"] in bench_judge.VERDICT_ORDER, key
+    assert result["regressions"] == []
+    assert result["accepted_run"] == "BENCH_r03.json"
+    # The seven-plus TPU-pending acceptance gates all await their capture.
+    assert result["counts"]["pending"] >= 7
+    # Nothing stale at HEAD: the gates file covers the declared surface.
+    assert result["stale"]["stale_gates"] == []
+    assert result["stale"]["ungated_keys"] == []
+
+
+def test_degraded_synthetic_run_flips_regress_via_cli(tmp_path):
+    """Appending a deliberately-degraded r04 (headline halved, sentinel
+    clean) to the real trajectory flips the headline key to ``regress``
+    and the CLI to a non-zero exit."""
+    paths = []
+    for name in ("BENCH_r01.json", "BENCH_r02.json", "BENCH_r03.json"):
+        paths.append(os.path.join(REPO, name))
+    with open(paths[-1]) as f:
+        degraded = json.load(f)
+    degraded["n"] = 4
+    degraded["parsed"] = dict(
+        degraded["parsed"],
+        value=degraded["parsed"]["value"] / 2.0,
+        contended=False,
+    )
+    r04 = tmp_path / "BENCH_r04.json"
+    r04.write_text(json.dumps(degraded))
+    proc = _run_cli("--json", "--trajectory", *paths, str(r04))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout)
+    assert result["verdicts"]["value"]["verdict"] == "regress"
+    assert "value" in result["regressions"]
+    # The un-degraded keys keep their classifications.
+    assert result["verdicts"]["bf16_meta_iters_per_s"]["verdict"] == "keep"
+
+    # The same degraded run marked contended is SKIPPED, not a regression
+    # (the sentinel's whole point: a poisoned number can't fail CI).
+    degraded["parsed"]["contended"] = True
+    r04.write_text(json.dumps(degraded))
+    proc2 = _run_cli("--json", "--trajectory", *paths, str(r04))
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    result2 = json.loads(proc2.stdout)
+    assert result2["accepted_run"] == "BENCH_r03.json"
+    assert result2["regressions"] == []
+
+
+def test_cli_human_table_renders():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stderr
+    assert "bench judge" in proc.stdout
+    assert "pending" in proc.stdout and "keep" in proc.stdout
+
+
+def test_trace_id_env_name_matches_dispatcher():
+    """The dispatcher duplicates TRACE_ID_ENV (stdlib-only import
+    surface); the two constants must never drift."""
+    import train_maml_system_dispatch as dispatch
+    from howtotrainyourmamlpytorch_tpu.telemetry import events
+
+    assert dispatch.TRACE_ID_ENV == events.TRACE_ID_ENV

@@ -197,6 +197,77 @@ def test_degrade_honors_global_batch_divisibility(harness):
     assert [c["dp"] for c in calls] == [6, 3]
 
 
+def test_audit_rows_enriched_with_heartbeat_progress(harness, tmp_path):
+    """ISSUE 12: the dispatcher reads the trainer heartbeat
+    (logs/status.json, telemetry/heartbeat.py) and stamps last-known
+    progress onto its degrade/requeue audit rows — the row says WHERE the
+    run was lost, not just that it was."""
+    import json as json_module
+
+    logs = tmp_path / "exp" / "logs"
+    os.makedirs(logs, exist_ok=True)
+    (logs / "status.json").write_text(
+        json_module.dumps(
+            {"schema": 1, "t": 1.0, "current_iter": 137, "epoch": 4}
+        )
+    )
+    rc, calls, audit = harness([
+        {"rc": dispatch.HANG_EXIT_CODE},            # hang -> degrade row
+        {"rc": 0, "epochs": 2, "test_eval": True},
+    ])
+    assert rc == 0
+    degrade = next(row for row in audit if "hang-degrade" in row)
+    cols = degrade.split(",")
+    # Header: timestamp,signal,current_iter,epoch,...
+    assert cols[2] == "137" and cols[3] == "4"
+
+
+def test_audit_rows_tolerate_missing_heartbeat(harness):
+    """Pre-heartbeat experiments (or a crash before the first beat) keep
+    the old empty-progress rows — enrichment degrades, never breaks."""
+    rc, calls, audit = harness([
+        {"rc": dispatch.HANG_EXIT_CODE},
+        {"rc": 0, "epochs": 2, "test_eval": True},
+    ])
+    assert rc == 0
+    degrade = next(row for row in audit if "hang-degrade" in row)
+    cols = degrade.split(",")
+    assert cols[2] == "" and cols[3] == ""
+
+
+def test_dispatcher_exports_one_trace_id_to_children(harness, monkeypatch):
+    """Every phase of a supervised run (and so every rank of a fleet
+    phase) inherits ONE MAML_TRACE_ID, making the whole elastic lifecycle
+    a single merged timeline; an operator-provided id wins."""
+    monkeypatch.delenv(dispatch.TRACE_ID_ENV, raising=False)
+    seen = []
+    real_run = dispatch.subprocess.run
+
+    def spying_run(argv, check=False, env=None):
+        seen.append((env or {}).get(dispatch.TRACE_ID_ENV))
+        return real_run(argv, check=check, env=env)
+
+    monkeypatch.setattr(dispatch.subprocess, "run", spying_run)
+    rc, _calls, _audit = harness([
+        {"rc": 0, "epochs": 1},
+        {"rc": 0, "epochs": 1, "test_eval": True},
+    ])
+    assert rc == 0
+    assert len(seen) == 2
+    assert seen[0] and seen[0] == seen[1]  # one id, every phase
+
+    seen.clear()
+    import shutil
+
+    shutil.rmtree("exp")  # fresh experiment: the finished run short-circuits
+    monkeypatch.setenv(dispatch.TRACE_ID_ENV, "operator-trace")
+    rc, _calls, _audit = harness([
+        {"rc": 0, "epochs": 2, "test_eval": True},
+    ])
+    assert rc == 0
+    assert seen == ["operator-trace"]  # inherited id wins
+
+
 def test_env_fault_plan_is_consumed_by_first_phase_only(harness, monkeypatch):
     monkeypatch.setenv("MAML_FAULTS", "hang_at_iter=3")
     rc, calls, _ = harness([

@@ -97,10 +97,57 @@ def test_telemetry_subsystem_lints_clean_standalone():
     names = {os.path.basename(p) for p in scanned}
     assert {
         "registry.py", "events.py", "profiling.py", "runtime.py",
-        "telemetry_report.py",
+        "heartbeat.py", "anomaly.py", "telemetry_report.py",
     } <= names
     assert lint_paths([telemetry_dir, report_tool]) == []
     # Zero suppressions: the subsystem must be clean on its own merits.
+    for path in scanned:
+        with open(path) as f:
+            assert "graftlint: disable" not in f.read(), path
+
+
+def test_observability_plane_lints_clean_standalone():
+    """The fleet observability plane (ISSUE 12) stays lint-clean as its
+    own target with ZERO suppressions: the bench judge + gate data, the
+    fleet report tool, the heartbeat/anomaly modules, and the
+    trace-stamping emitters. Also asserts the linter actually DISCOVERED
+    the modules (an empty scan would vacuously pass)."""
+    targets = [
+        os.path.join(REPO, "tools", "bench_judge.py"),
+        os.path.join(REPO, "tools", "telemetry_report.py"),
+        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "telemetry"),
+        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "utils",
+                     "watchdog.py"),
+        os.path.join(REPO, "train_maml_system_dispatch.py"),
+        os.path.join(REPO, "bench.py"),
+    ]
+    for target in targets:
+        assert os.path.exists(target), target
+    # The gate DATA rides next to the judge: it must parse and carry the
+    # schema the judge reads (a malformed gates file would otherwise only
+    # surface on the next judge run).
+    import json as json_module
+
+    with open(os.path.join(REPO, "tools", "bench_gates.json")) as f:
+        gates_doc = json_module.load(f)
+    assert gates_doc["schema"] == 1 and gates_doc["gates"]
+    proc = run_cli(*targets)
+    assert proc.returncode == 0, (
+        "graftlint found violations in the observability plane:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert "graftlint: clean" in proc.stderr
+
+    from tools.graftlint import lint_paths
+    from tools.graftlint.engine import _collect_files
+
+    scanned = _collect_files(targets)
+    names = {os.path.basename(p) for p in scanned}
+    assert {
+        "bench_judge.py", "telemetry_report.py", "heartbeat.py",
+        "anomaly.py", "events.py", "runtime.py", "watchdog.py",
+    } <= names
+    assert lint_paths(targets) == []
     for path in scanned:
         with open(path) as f:
             assert "graftlint: disable" not in f.read(), path

@@ -611,6 +611,63 @@ def test_fleet_telemetry_attributes_both_ranks(fleet_run):
         assert len(train_compiles) == 2, (rank, len(train_compiles))
 
 
+def test_fleet_trace_ids_consistent_and_report_merges_ranks(fleet_run):
+    """ISSUE 12 acceptance on a REAL 2-rank run through the dispatcher
+    CLI: every rank's events carry the ONE dispatcher-exported trace_id,
+    step events carry rank-aligned dispatch_ids, and
+    ``tools/telemetry_report.py --fleet`` renders the shared JSONL as one
+    merged timeline with per-rank lanes and per-dispatch slowest-rank
+    attribution."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import read_events
+
+    jsonl = os.path.join(fleet_run["fleet_dir"], "logs", "telemetry.jsonl")
+    events = read_events(jsonl)
+    trace_ids = {
+        e["trace_id"] for e in events
+        if e.get("type") != "schema" and "trace_id" in e
+    }
+    assert len(trace_ids) == 1, trace_ids  # one trace across both ranks
+    steps = [e for e in events if e.get("type") == "step"]
+    by_rank = {
+        rank: sorted(
+            e["dispatch_id"] for e in steps
+            if int(e["process_index"]) == rank
+        )
+        for rank in (0, 1)
+    }
+    # Lockstep fleet: both ranks dispatched the same iteration windows —
+    # equal dispatch_id sets are what make cross-rank attribution a join.
+    assert by_rank[0] == by_rank[1] and by_rank[0]
+
+    from tools.telemetry_report import fleet_summarize, render_fleet_text
+
+    summary = fleet_summarize([fleet_run["fleet_dir"]])
+    assert summary["ranks"] == [0, 1]
+    assert summary["trace_consistent"]
+    assert summary["dispatch_skew"]["dispatches"] == len(by_rank[0])
+    assert set(summary["slowest_rank_dispatches"]) <= {"0", "1"}
+    text = render_fleet_text(summary)
+    assert "per-rank step lanes" in text
+    assert "slowest-rank attribution" in text
+
+
+def test_fleet_ranks_write_per_rank_heartbeats(fleet_run):
+    """Both ranks of the shared logs dir heartbeat without racing one
+    rename target: rank 0 owns status.json (what the dispatcher reads),
+    rank 1 writes status.r1.json — each with its own identity and
+    progress."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import read_heartbeat
+
+    logs = os.path.join(fleet_run["fleet_dir"], "logs")
+    chief = read_heartbeat(os.path.join(logs, "status.json"))
+    peer = read_heartbeat(os.path.join(logs, "status.r1.json"))
+    assert chief is not None and peer is not None
+    assert chief["process_index"] == 0 and peer["process_index"] == 1
+    assert chief["trace_id"] == peer["trace_id"]
+    assert chief["current_iter"] == peer["current_iter"] == 6
+    assert chief["epoch"] is not None
+
+
 def test_fleet_chief_is_the_single_writer(fleet_run):
     """Rank 0 owns checkpoints and the summary CSV; the telemetry stream
     carries both ranks (attribution), the CSV carries one epoch row per

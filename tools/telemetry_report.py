@@ -5,6 +5,17 @@ host-sync), XLA compile timeline, checkpoint/sentinel/preemption event log::
 
     python tools/telemetry_report.py <experiment-dir | telemetry.jsonl>
     python tools/telemetry_report.py <run> --json     # machine-readable
+    python tools/telemetry_report.py <run> --since <unix-s>   # tail window
+
+Fleet mode — merge multiple ranks' JSONL streams (separate files, a shared
+multi-rank file, or both) into ONE ordered timeline with per-rank lanes,
+per-dispatch slowest-rank attribution and cross-rank skew stats (the
+diagnostic the per-leaf-all-reduce finding in PERF_NOTES.md needed by
+hand). Ranks correlate on the run-scoped ``trace_id`` + per-dispatch
+``dispatch_id`` the telemetry layer stamps end to end::
+
+    python tools/telemetry_report.py --fleet <run-or-jsonl> [<run...>]
+    python tools/telemetry_report.py --fleet <runs...> --json
 
 Overhead bench mode — the ``telemetry_overhead_pct`` key (PERF_NOTES.md
 "Telemetry overhead" protocol): drives the REAL K=1 ``run_train_iter`` loop
@@ -35,6 +46,7 @@ import numpy as np  # noqa: E402
 
 from howtotrainyourmamlpytorch_tpu.telemetry import (  # noqa: E402
     SCHEMA_VERSION,
+    EventReader,
     read_events,
 )
 
@@ -194,6 +206,269 @@ def render_text(summary: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Fleet mode: merged multi-rank timeline + cross-rank dispatch attribution
+# ---------------------------------------------------------------------------
+
+#: Event types folded into the per-rank step lanes rather than the merged
+#: timeline (one line per dispatch would drown the event log).
+_LANE_TYPES = ("step",)
+
+#: Timeline length cap in the human rendering — a multi-GB run must not
+#: print a multi-GB table.
+_TIMELINE_LIMIT = 200
+
+#: Non-step events RETAINED for the merged timeline (the newest ones — a
+#: post-mortem reads from the end). Everything still counts into
+#: ``event_counts``; bounding retention is what keeps the fleet summary's
+#: memory and ``--json`` payload finite on multi-day runs, matching the
+#: streaming reader underneath.
+_JSON_TIMELINE_LIMIT = 5000
+
+
+def _rank_of(event: dict, default: int = 0) -> int:
+    return int(event.get("process_index", default))
+
+
+def fleet_events(paths: list[str], since: float | None = None):
+    """Streams events from every resolved run path (dirs or JSONL files)
+    via the offset-aware reader — multi-GB per-rank logs iterate instead of
+    loading whole (a killed writer's complete-but-unterminated last line
+    included). A rank may span files AND a file may hold several ranks
+    (the shared-logs-dir fleet layout); ``process_index`` on each event is
+    the lane key either way."""
+    for path in paths:
+        reader = EventReader(resolve_jsonl(path))
+        yield from reader.iter_events(since=since, include_tail=True)
+
+
+def fleet_summarize(paths: list[str], since: float | None = None) -> dict:
+    """The fleet report's data model (the ``--fleet --json`` schema):
+    per-rank step lanes, per-dispatch slowest-rank attribution keyed on
+    ``dispatch_id``, cross-rank skew percentiles, trace consistency, and
+    the merged non-step timeline (newest ``_JSON_TIMELINE_LIMIT`` events
+    retained)."""
+    import collections
+
+    lanes: dict[int, dict[str, list[float]]] = {}
+    dispatches: dict[object, dict[int, list[dict]]] = {}
+    timeline: collections.deque = collections.deque(
+        maxlen=_JSON_TIMELINE_LIMIT
+    )
+    timeline_total = 0
+    trace_ids: set[str] = set()
+    counts: dict[str, int] = {}
+    t0 = None
+    for event in fleet_events(paths, since=since):
+        etype = event.get("type", "?")
+        counts[etype] = counts.get(etype, 0) + 1
+        t = float(event.get("t", 0.0))
+        t0 = t if t0 is None else min(t0, t)
+        if "trace_id" in event:
+            trace_ids.add(str(event["trace_id"]))
+        if etype == "schema":
+            continue
+        rank = _rank_of(event)
+        if etype in _LANE_TYPES:
+            k = max(int(event.get("k", 1)), 1)
+            lane = lanes.setdefault(
+                rank, {"step": [], "data_wait": [], "stage_wait": [],
+                       "device": []}
+            )
+            lane["step"].extend([float(event["step_s"]) / k] * k)
+            lane["data_wait"].extend(
+                [float(event.get("data_wait_s", 0.0)) / k] * k
+            )
+            lane["stage_wait"].extend(
+                [float(event.get("stage_wait_s", 0.0)) / k] * k
+            )
+            lane["device"].extend(
+                [float(event.get("device_s", 0.0)) / k] * k
+            )
+            dispatch_id = event.get("dispatch_id", event.get("iter"))
+            if dispatch_id is not None:
+                # Per-rank OCCURRENCE LIST, not a single slot: an elastic
+                # run replays iterations after a degrade/resume (same
+                # dispatch_id, later phase — one trace by design), and a
+                # replayed sample must pair with the peer ranks' REPLAY of
+                # that iteration, not overwrite a dead phase's entry and
+                # fabricate skew against it.
+                dispatches.setdefault(dispatch_id, {}).setdefault(
+                    rank, []
+                ).append({
+                    "t": t,
+                    "step_s": float(event["step_s"]),
+                    "device_s": float(event.get("device_s", 0.0)),
+                })
+        else:
+            timeline.append(event)
+            timeline_total += 1
+
+    timeline = sorted(timeline, key=lambda e: float(e.get("t", 0.0)))
+    t0 = t0 or 0.0
+
+    # Per-dispatch attribution: the i-th occurrence of a dispatch_id on
+    # each rank is the same logical dispatch (lockstep fleets replay
+    # together); occurrences observed on >= 2 ranks carry cross-rank
+    # information — the skew is max-min step time, the slowest rank is
+    # the straggler the skew points at.
+    skews, slowest_counts = [], {}
+    for dispatch_id, per_rank in dispatches.items():
+        for occurrence in range(max(len(rows) for rows in per_rank.values())):
+            by_step = {
+                rank: rows[occurrence]["step_s"]
+                for rank, rows in per_rank.items()
+                if occurrence < len(rows)
+            }
+            if len(by_step) < 2:
+                continue
+            slowest = max(by_step, key=by_step.get)
+            skew_s = max(by_step.values()) - min(by_step.values())
+            skews.append((dispatch_id, slowest, skew_s))
+            slowest_counts[slowest] = slowest_counts.get(slowest, 0) + 1
+    skew_values = np.asarray([s for _, _, s in skews], dtype=np.float64)
+    skew_stats = (
+        {
+            "dispatches": int(skew_values.size),
+            "p50_ms": float(np.percentile(skew_values, 50) * 1e3),
+            "p95_ms": float(np.percentile(skew_values, 95) * 1e3),
+            "max_ms": float(np.max(skew_values) * 1e3),
+        }
+        if skew_values.size
+        else {"dispatches": 0}
+    )
+    worst = sorted(skews, key=lambda row: -row[2])[:5]
+
+    lane_summaries = {
+        rank: {
+            name: _percentiles_ms(samples)
+            for name, samples in lane.items()
+            if samples
+        }
+        for rank, lane in sorted(lanes.items())
+    }
+    process_count = max(
+        [int(e.get("process_count", 1)) for e in timeline] + [len(lanes), 1]
+    )
+    return {
+        "schema": SCHEMA_VERSION,
+        "sources": [resolve_jsonl(p) for p in paths],
+        "ranks": sorted(lanes),
+        "process_count": process_count,
+        "trace_ids": sorted(trace_ids),
+        # One run-scoped trace across every lane is what makes the merge a
+        # single timeline rather than a coincidence of files.
+        "trace_consistent": len(trace_ids) <= 1,
+        "lanes": lane_summaries,
+        "dispatch_skew": skew_stats,
+        "slowest_rank_dispatches": {
+            str(rank): n for rank, n in sorted(slowest_counts.items())
+        },
+        "worst_dispatches": [
+            {
+                "dispatch_id": dispatch_id,
+                "slowest_rank": rank,
+                "skew_ms": round(skew_s * 1e3, 3),
+            }
+            for dispatch_id, rank, skew_s in worst
+        ],
+        "t0": t0,
+        "timeline_events_total": timeline_total,
+        "timeline_truncated": timeline_total > len(timeline),
+        "timeline": [
+            {
+                "t_rel_s": round(float(e.get("t", 0.0)) - t0, 3),
+                "rank": _rank_of(e),
+                **{
+                    key: value
+                    for key, value in e.items()
+                    if key not in ("t", "signature", "stacks", "trace_id")
+                },
+            }
+            for e in timeline
+        ],
+        "event_counts": counts,
+    }
+
+
+def render_fleet_text(summary: dict) -> str:
+    lines = []
+    ranks = summary["ranks"] or [0]
+    trace = (
+        summary["trace_ids"][0]
+        if len(summary["trace_ids"]) == 1
+        else f"INCONSISTENT {summary['trace_ids']}"
+        if summary["trace_ids"]
+        else "(unstamped)"
+    )
+    lines.append(
+        f"fleet telemetry report — {len(summary['sources'])} source(s), "
+        f"rank lane(s) {'+'.join(str(r) for r in ranks)} of "
+        f"{summary['process_count']}, trace {trace}"
+    )
+    lines.append("")
+    lines.append("per-rank step lanes (per iteration)")
+    header = (
+        f"  {'rank':<5} {'component':<12} {'count':>7} {'p50 ms':>10} "
+        f"{'p95 ms':>10} {'mean ms':>10} {'total s':>9}"
+    )
+    lines.append(header)
+    lines.append("  " + "-" * (len(header) - 2))
+    for rank, lane in summary["lanes"].items():
+        for name in ("step", "data_wait", "stage_wait", "device"):
+            row = lane.get(name)
+            if row is None:
+                continue
+            lines.append(
+                f"  {rank:<5} {name:<12} {row['count']:>7} "
+                f"{row['p50_ms']:>10.3f} {row['p95_ms']:>10.3f} "
+                f"{row['mean_ms']:>10.3f} {row['total_s']:>9.2f}"
+            )
+    skew = summary["dispatch_skew"]
+    lines.append("")
+    if skew.get("dispatches"):
+        lines.append(
+            f"cross-rank dispatch skew over {skew['dispatches']} shared "
+            f"dispatches: p50 {skew['p50_ms']:.3f} ms, "
+            f"p95 {skew['p95_ms']:.3f} ms, max {skew['max_ms']:.3f} ms"
+        )
+        shares = ", ".join(
+            f"rank {rank}: {n}"
+            for rank, n in summary["slowest_rank_dispatches"].items()
+        )
+        lines.append(f"slowest-rank attribution (dispatch counts): {shares}")
+        for row in summary["worst_dispatches"]:
+            lines.append(
+                f"  dispatch {row['dispatch_id']}: rank "
+                f"{row['slowest_rank']} slowest by {row['skew_ms']:.3f} ms"
+            )
+    else:
+        lines.append(
+            "cross-rank dispatch skew: no dispatch observed on >= 2 ranks "
+            "(single-rank stream, or pre-dispatch_id logs)"
+        )
+    lines.append("")
+    timeline = summary["timeline"]
+    total = summary.get("timeline_events_total", len(timeline))
+    shown = timeline[:_TIMELINE_LIMIT]
+    lines.append(
+        f"merged timeline ({total} events"
+        + (f", {len(shown)} shown" if len(shown) < total else "")
+        + ")"
+    )
+    for event in shown:
+        fields = ", ".join(
+            f"{key}={value}"
+            for key, value in event.items()
+            if key not in ("t_rel_s", "type", "rank", "metrics")
+        )
+        lines.append(
+            f"  +{event['t_rel_s']:>9.3f}s  r{event['rank']}  "
+            f"{event['type']:<18} {fields}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # Overhead bench mode (the telemetry_overhead_pct key)
 # ---------------------------------------------------------------------------
 
@@ -347,6 +622,13 @@ def main(argv=None) -> int:
     )
     parser.add_argument("run", nargs="?", default=None,
                         help="experiment dir or telemetry.jsonl path")
+    parser.add_argument("--fleet", nargs="+", metavar="RUN",
+                        help="merge multiple ranks' runs/JSONLs into one "
+                             "timeline with per-rank lanes, per-dispatch "
+                             "slowest-rank attribution and skew stats")
+    parser.add_argument("--since", type=float, default=None,
+                        help="only events stamped at/after this unix time "
+                             "(streams from the offset-aware reader)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable summary instead of tables")
     parser.add_argument("--overhead-bench", action="store_true",
@@ -366,9 +648,18 @@ def main(argv=None) -> int:
             )
         ))
         return 0
+    if opts.fleet:
+        paths = list(opts.fleet) + ([opts.run] if opts.run else [])
+        summary = fleet_summarize(paths, since=opts.since)
+        print(json.dumps(summary) if opts.json
+              else render_fleet_text(summary))
+        return 0
     if not opts.run:
-        parser.error("a run path is required unless --overhead-bench")
-    summary = summarize(read_events(resolve_jsonl(opts.run)))
+        parser.error("a run path is required unless "
+                     "--overhead-bench/--fleet")
+    summary = summarize(
+        read_events(resolve_jsonl(opts.run), since=opts.since)
+    )
     if opts.json:
         print(json.dumps(summary))
     else:

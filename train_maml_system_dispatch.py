@@ -69,6 +69,7 @@ import signal
 import subprocess
 import sys
 import time
+import uuid
 
 #: Preemption requeue (experiment_builder.REQUEUE_EXIT_CODE): emergency
 #: checkpoint written, resume on the SAME mesh.
@@ -76,6 +77,12 @@ REQUEUE_EXIT_CODE = 75
 #: Watchdog hang (utils/watchdog.HANG_EXIT_CODE): requeue but SUSPECT THE
 #: TOPOLOGY — resume on the next-smaller viable mesh.
 HANG_EXIT_CODE = 76
+#: Run-scoped trace id env (telemetry/events.TRACE_ID_ENV — pinned equal
+#: by tests/test_telemetry.py): exported once per dispatcher run so every
+#: phase, and every rank of a fleet phase, stamps the SAME trace_id on its
+#: telemetry — the whole elastic lifecycle (hangs, degrades, resumes)
+#: merges into one timeline in ``tools/telemetry_report.py --fleet``.
+TRACE_ID_ENV = "MAML_TRACE_ID"
 
 #: Test hook: overrides which entry script a phase runs (the budget/degrade
 #: policy is provable without compiling real XLA programs). Internal.
@@ -91,14 +98,42 @@ def _pop_flag(extra, name, default, cast):
     return default
 
 
+def _heartbeat_progress(exp_name: str) -> tuple:
+    """Last-known progress from the trainer heartbeat
+    (``logs/status.json``, written atomically at forced-read boundaries —
+    telemetry/heartbeat.py). Returns ``(current_iter, epoch)`` as strings
+    for the audit row; empty strings when no (valid) heartbeat exists —
+    the pre-heartbeat behavior of inferring nothing from exit codes."""
+    try:
+        from howtotrainyourmamlpytorch_tpu.telemetry.heartbeat import (
+            read_heartbeat,
+        )
+
+        doc = read_heartbeat(os.path.join(exp_name, "logs", "status.json"))
+    except Exception:  # noqa: BLE001 — auditing must not break supervision
+        doc = None
+    if not doc:
+        return "", ""
+    current_iter = doc.get("current_iter")
+    epoch = doc.get("epoch")
+    return (
+        "" if current_iter is None else str(current_iter),
+        "" if epoch is None else str(epoch),
+    )
+
+
 def _audit_row(exp_name: str, kind: str, process_index="",
-               process_count="", when: float | None = None) -> None:
+               process_count="", when: float | None = None,
+               current_iter="", epoch="") -> None:
     """Appends a dispatcher audit row to the experiment's interruptions
     CSV (same header the builder's preemption rows use, so one file holds
     the full interruption history). ``process_index``/``process_count``
     attribute host-loss rows to the rank that died; supervisor-policy rows
-    (degrade/promote) leave them empty. Rows align to the file's existing
-    header so pre-multi-host experiments keep their 4-column layout."""
+    (degrade/promote) leave them empty. ``current_iter``/``epoch`` carry
+    the heartbeat's last-known progress (``_heartbeat_progress``) — the
+    row says WHERE the run was lost, not just that it was. Rows align to
+    the file's existing header so pre-multi-host experiments keep their
+    4-column layout."""
     logs = os.path.join(exp_name, "logs")
     header = ("timestamp,signal,current_iter,epoch,"
               "process_index,process_count")
@@ -111,7 +146,7 @@ def _audit_row(exp_name: str, kind: str, process_index="",
         with open(path) as f:
             n_cols = len(f.readline().rstrip("\n").split(","))
         row = [str(time.time() if when is None else when), str(kind),
-               "", "",
+               str(current_iter), str(epoch),
                str(process_index), str(process_count)][:max(n_cols, 4)]
         with open(path, "a") as f:
             f.write(",".join(row) + "\n")
@@ -394,6 +429,10 @@ def main() -> int:
         max_phases = 2 * (total_epochs // (pause_every or total_epochs) + 2)
         stalled = phase = requeues = hangs = signal_deaths = 0
         child_env = dict(os.environ)
+        # One trace id for the whole supervised run (all phases, all
+        # ranks): an inherited id wins — a higher-level orchestrator may
+        # already have scoped the trace.
+        child_env.setdefault(TRACE_ID_ENV, uuid.uuid4().hex[:16])
         rc = 0
         while (
             phase < max_phases
@@ -442,6 +481,10 @@ def main() -> int:
                 # checkpoint (mesh-portable restore).
                 hangs += 1
                 stalled = signal_deaths = 0
+                # Last-known progress from the heartbeat: the audit row
+                # records where the run was when the topology failed, not
+                # just the exit code the failure produced.
+                hb_iter, hb_epoch = _heartbeat_progress(exp_name)
                 if fleet:
                     smaller = _next_smaller_procs(
                         cfg_dict, current_procs, local_devices
@@ -463,6 +506,7 @@ def main() -> int:
                             ),
                             process_count=current_procs,
                             when=first_exit_wall,
+                            current_iter=hb_iter, epoch=hb_epoch,
                         )
                         print(f"--- {cfg}: {why} (rc {rc}); degrading "
                               f"fleet {current_procs} -> {smaller} "
@@ -482,6 +526,7 @@ def main() -> int:
                             ),
                             process_count=current_procs,
                             when=first_exit_wall,
+                            current_iter=hb_iter, epoch=hb_epoch,
                         )
                         print(f"--- {cfg}: {why} (rc {rc}) with no "
                               "smaller viable fleet; requeueing on the "
@@ -500,12 +545,14 @@ def main() -> int:
                     _audit_row(
                         exp_name,
                         f"{why}-degrade:dp{current_dp}->dp{smaller}",
+                        current_iter=hb_iter, epoch=hb_epoch,
                     )
                     print(f"--- {cfg}: {why} (rc {rc}); degrading mesh "
                           f"dp{current_dp} -> dp{smaller} and resuming "
                           "from the last valid checkpoint", flush=True)
                 else:
-                    _audit_row(exp_name, f"{why}-requeue:dp{current_dp}")
+                    _audit_row(exp_name, f"{why}-requeue:dp{current_dp}",
+                               current_iter=hb_iter, epoch=hb_epoch)
                     print(f"--- {cfg}: {why} (rc {rc}) with no smaller "
                           "viable mesh; requeueing on the same topology",
                           flush=True)
