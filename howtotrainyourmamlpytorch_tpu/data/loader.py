@@ -23,6 +23,7 @@ import atexit
 import collections
 import concurrent.futures
 import concurrent.futures.process
+import json
 import multiprocessing
 import queue
 import threading
@@ -31,6 +32,54 @@ import time
 import numpy as np
 
 from .dataset import FewShotLearningDataset
+
+#: Replay-manifest schema this loader reads (tools/episode_miner.py
+#: writes it). Newer schemas are refused — never misread.
+REPLAY_MANIFEST_SCHEMA = 1
+
+
+def load_replay_manifest(path: str) -> tuple[int, ...]:
+    """Mined hard-episode seeds from a ``tools/episode_miner.py`` replay
+    manifest, in manifest order (hardest first). Fail-fast on a missing/
+    malformed file — a training run silently dropping its curriculum is
+    worse than refusing to start."""
+    with open(path) as f:
+        manifest = json.load(f)
+    if int(manifest.get("schema", -1)) > REPLAY_MANIFEST_SCHEMA:
+        raise ValueError(
+            f"{path}: replay manifest schema {manifest.get('schema')} is "
+            f"newer than this build reads (up to {REPLAY_MANIFEST_SCHEMA})"
+        )
+    seeds = tuple(
+        int(row["seed"]) for row in manifest.get("episodes", [])
+    )
+    if not seeds:
+        raise ValueError(f"{path}: replay manifest holds no episodes")
+    return seeds
+
+
+def replay_seed(
+    seed_base: int,
+    idx: int,
+    replay_seeds: tuple[int, ...],
+    replay_every: int,
+    offset: int = 0,
+) -> int:
+    """Episode seed for within-generator index ``idx``: every
+    ``replay_every``-th GLOBAL slot draws the next mined seed (cycled)
+    instead of the fresh ``seed_base + idx`` — a deterministic hard-task
+    mix-in (the dataset synthesizes episodes as pure functions of the
+    seed, so a mined serving episode replays bit-exactly). ``offset`` is
+    the run's global episode offset (the resume-fast-forwarded seed
+    window), so slot selection and the mined-seed cycle are keyed to the
+    GLOBAL episode index: a resumed run replays exactly the slots the
+    uninterrupted run would have — the loader's pinned resume
+    bit-exactness holds with a manifest active. With no manifest this is
+    exactly the historical seed rule."""
+    slot = offset + idx
+    if replay_seeds and replay_every > 0 and (slot + 1) % replay_every == 0:
+        return int(replay_seeds[(slot // replay_every) % len(replay_seeds)])
+    return seed_base + idx
 
 
 class _ProducerError:
@@ -59,7 +108,9 @@ _FORK_DATASET: FewShotLearningDataset | None = None
 
 
 def _synthesize_batch_in_worker(set_name, seed_base, augment, b, global_batch,
-                                shard_lo, shard_size):
+                                shard_lo, shard_size,
+                                replay_seeds=(), replay_every=0,
+                                replay_offset=0):
     """One collated batch (this process's shard of it), synthesized inside
     a forked worker process. Episode parameters are explicit (snapshot
     semantics identical to the thread backend); only the collated arrays
@@ -67,7 +118,13 @@ def _synthesize_batch_in_worker(set_name, seed_base, augment, b, global_batch,
     ds = _FORK_DATASET
     base = b * global_batch + shard_lo
     return _collate_episodes([
-        ds.get_set(set_name, seed=seed_base + idx, augment_images=augment)
+        ds.get_set(
+            set_name,
+            seed=replay_seed(
+                seed_base, idx, replay_seeds, replay_every, replay_offset
+            ),
+            augment_images=augment,
+        )
         for idx in range(base, base + shard_size)
     ])
 
@@ -101,6 +158,20 @@ class MetaLearningSystemDataLoader:
                 f"{self.shard_count} shard(s)"
             )
         self.total_train_iters_produced = 0
+        # Hard-episode replay mix-in (tools/episode_miner.py feedback
+        # edge): every ``replay_every``-th TRAIN episode slot draws a
+        # mined seed instead of the fresh one. Off unless a manifest is
+        # configured; val/test streams are never touched.
+        manifest_path = str(
+            getattr(args, "replay_manifest", "") or ""
+        ).strip()
+        self.replay_seeds: tuple[int, ...] = (
+            load_replay_manifest(manifest_path) if manifest_path else ()
+        )
+        self.replay_every = (
+            max(int(getattr(args, "replay_every", 8) or 0), 0)
+            if self.replay_seeds else 0
+        )
         self.dataset = FewShotLearningDataset(args=args)
         self.batches_per_iter = args.samples_per_iter
         self.full_data_length = dict(self.dataset.data_length)
@@ -190,7 +261,8 @@ class MetaLearningSystemDataLoader:
         return _collate_episodes(episodes)
 
     def _iter_batches(self, set_name: str, seed_base: int, augment: bool,
-                      length: int, prefetch: int = 2):
+                      length: int, prefetch: int = 2,
+                      replay: tuple | None = None):
         """Yields collated batches of ``global_batch`` episodes, synthesized
         by the thread pool and prefetched ``prefetch`` batches ahead.
         ``drop_last=True`` like the reference.
@@ -207,6 +279,9 @@ class MetaLearningSystemDataLoader:
         overfitting) the 50-class val split."""
         n_batches = length // self.global_batch
         shard_lo, shard_size = self.shard_lo, self.shard_size
+        replay_seeds, replay_every, replay_offset = (
+            replay if replay else ((), 0, 0)
+        )
         out: queue.Queue = queue.Queue(maxsize=prefetch)
         sentinel = object()
 
@@ -215,7 +290,8 @@ class MetaLearningSystemDataLoader:
                 return self._pool.submit(
                     _synthesize_batch_in_worker,
                     set_name, seed_base, augment, b, self.global_batch,
-                    shard_lo, shard_size,
+                    shard_lo, shard_size, replay_seeds, replay_every,
+                    replay_offset,
                 )
         else:
             def synthesize_batch(b: int):
@@ -226,7 +302,12 @@ class MetaLearningSystemDataLoader:
                 base = b * self.global_batch + shard_lo
                 return _collate_episodes([
                     self.dataset.get_set(
-                        set_name, seed=seed_base + idx, augment_images=augment
+                        set_name,
+                        seed=replay_seed(
+                            seed_base, idx, replay_seeds, replay_every,
+                            replay_offset,
+                        ),
+                        augment_images=augment,
                     )
                     for idx in range(base, base + shard_size)
                 ])
@@ -305,6 +386,16 @@ class MetaLearningSystemDataLoader:
         yield from self._iter_batches(
             "train", int(self.dataset.seed["train"]), augment_images,
             self.dataset.data_length["train"],
+            replay=(
+                self.replay_seeds,
+                self.replay_every,
+                # Global episode offset of this generator call: the seed
+                # window's distance from the run's origin (identical in a
+                # resumed and an uninterrupted run by the pinned seed
+                # fast-forward contract).
+                int(self.dataset.seed["train"])
+                - int(self.dataset.init_seed["train"]),
+            ),
         )
 
     def get_val_batches(self, total_batches: int = -1, augment_images: bool = False):

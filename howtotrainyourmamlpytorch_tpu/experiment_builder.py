@@ -43,6 +43,7 @@ from .utils.checkpoint import (
     AsyncCheckpointWriter,
     CheckpointCorruptError,
     publish_alias,
+    publish_done_marker,
 )
 from .utils.watchdog import HANG_EXIT_CODE, DispatchWatchdog
 from .utils.storage import (
@@ -992,7 +993,13 @@ class ExperimentBuilder:
         t0 = time.perf_counter()
         if self._ckpt_writer is not None and hasattr(model, "snapshot_model"):
             snapshot = model.snapshot_model(self.train_state, state)
-            self._ckpt_writer.submit(epoch_path, snapshot, alias_dst=latest)
+            # publish_marker: the ``.ready`` done-marker is written LAST
+            # (after archive + alias) so a checkpoint-directory watcher —
+            # the promotion daemon — only ever sees fully-settled epoch
+            # candidates (rename-last ordering; utils/checkpoint.py).
+            self._ckpt_writer.submit(
+                epoch_path, snapshot, alias_dst=latest, publish_marker=True
+            )
             self.telemetry.event(
                 "checkpoint_submit",
                 path=os.path.basename(epoch_path),
@@ -1003,6 +1010,7 @@ class ExperimentBuilder:
         else:
             model.save_model(epoch_path, self.train_state, state)
             publish_alias(epoch_path, latest)
+            publish_done_marker(epoch_path)
         self._last_ckpt_t = time.monotonic()
         print("saved models to", self.saved_models_filepath)
 

@@ -85,7 +85,8 @@ class ServingAPI:
     # ------------------------------------------------------------------
 
     def classify(
-        self, x_support, y_support, x_query, *, timeout: float | None = 30.0
+        self, x_support, y_support, x_query, *,
+        timeout: float | None = 30.0, tag: str | None = None,
     ) -> dict:
         """Adapts to the support set and classifies the queries.
 
@@ -104,7 +105,7 @@ class ServingAPI:
         self.metrics.requests_total.inc()
         try:
             episode = self.engine.prepare_episode(
-                x_support, y_support, x_query
+                x_support, y_support, x_query, tag=tag
             )
             cache_hit = episode.digest in self.engine.cache
             self.admission.admit(
@@ -162,6 +163,10 @@ class ServingAPI:
             )
         else:
             result = promote_state(self.engine, state, buckets=buckets)
+        # Post-publish regression fault arms the moment the publish lands
+        # (single-engine front door; the pool fires its own on fleet-wide
+        # promotes).
+        faultinject.promotion_applied()
         return {
             "state_version": result.version,
             "buckets_canaried": len(result.buckets_canaried),
@@ -189,6 +194,7 @@ class ServingAPI:
             "degraded": degraded,
             "family": self.engine.family,
             "state_version": self.engine.state_version,
+            "checkpoint_digest": self.engine.published_digest,
             "uptime_s": time.time() - self.started_at,
             "episodes_served": self.metrics.episodes_served.value,
             "queue_depth": queue_depth,
@@ -320,6 +326,7 @@ class _Handler(BaseHTTPRequestHandler):
                 payload["support"],
                 payload["support_labels"],
                 payload["query"],
+                tag=payload.get("tag"),
             )
         except OverloadedError as exc:
             self._send_json(

@@ -50,6 +50,32 @@ from .metrics import ServeMetrics
 
 Tree = Any
 
+#: Hard cap on the opaque per-episode telemetry tag (it rides every
+#: serve_dispatch event; an unbounded client string must not bloat the
+#: JSONL stream).
+MAX_TAG_LEN = 128
+
+
+def confidence_stats(logits: np.ndarray) -> tuple[float, float]:
+    """Per-episode prediction confidence from HOST logits ``(T, C)``:
+    mean top1-top2 softmax margin and mean predictive entropy over the
+    queries. Pure numpy on an already-fetched array — zero device syncs;
+    non-finite logits degrade to NaN stats (serialized as null by the
+    event layer), never an exception."""
+    logits = np.asarray(logits, np.float64)
+    if logits.ndim != 2 or logits.shape[-1] < 2:
+        return 1.0, 0.0
+    with np.errstate(invalid="ignore", over="ignore"):
+        z = logits - np.max(logits, axis=-1, keepdims=True)
+        p = np.exp(z)
+        p = p / np.sum(p, axis=-1, keepdims=True)
+        top2 = np.partition(p, -2, axis=-1)[..., -2:]
+        margin = float(np.mean(top2[..., 1] - top2[..., 0]))
+        entropy = float(
+            np.mean(-np.sum(p * np.log(np.clip(p, 1e-12, None)), axis=-1))
+        )
+    return margin, entropy
+
 
 class _Published(NamedTuple):
     """The served checkpoint, published as ONE immutable object so readers
@@ -128,6 +154,12 @@ class EpisodeRequest:
     #: DROPS episodes already past it before dispatch (work nobody is
     #: waiting for must not occupy the device).
     deadline: float | None = None
+    #: Optional opaque client tag riding the episode into telemetry
+    #: (``serve_dispatch`` events). Callers that drew the episode from the
+    #: dataset distribution encode its synthesis seed as ``"seed:<int>"``,
+    #: which is what lets ``tools/episode_miner.py`` turn low-margin
+    #: serving episodes back into trainable replay seeds.
+    tag: str | None = None
 
     @property
     def bucket(self) -> tuple[int, int, int]:
@@ -180,6 +212,13 @@ class ServingEngine:
         # up across replicas in tools/telemetry_report.py --fleet.
         self.trace_id = telemetry_events.ensure_trace_id()
         self._dispatch_seq = 0
+        # Provenance of the served state, stamped by the SAFE promote
+        # paths (serve/resilience/swap.py): the content digest + source
+        # path of the last promoted checkpoint, or None for the boot
+        # state / raw update_state publishes. The promotion daemon reads
+        # this through /healthz to resume idempotently after a crash.
+        self.published_digest: str | None = None
+        self.published_source: str | None = None
         self._adapt, self._classify = self._build_programs()
 
     # ------------------------------------------------------------------
@@ -266,7 +305,9 @@ class ServingEngine:
     # Request preparation
     # ------------------------------------------------------------------
 
-    def prepare_episode(self, x_support, y_support, x_query) -> EpisodeRequest:
+    def prepare_episode(
+        self, x_support, y_support, x_query, *, tag: str | None = None
+    ) -> EpisodeRequest:
         """Validates + wire-encodes one raw episode.
 
         Accepts ``(way, shot, C, H, W)`` / ``(T, C, H, W)`` structured or
@@ -329,9 +370,11 @@ class ServingEngine:
         digest = support_digest(
             xs, ys, learner=self.family, state_version=self.state_version
         )
+        if tag is not None:
+            tag = str(tag)[:MAX_TAG_LEN]
         return EpisodeRequest(
             x_support=xs, y_support=ys, x_query=xq,
-            way=way, shot=shot, digest=digest,
+            way=way, shot=shot, digest=digest, tag=tag,
         )
 
     # ------------------------------------------------------------------
@@ -417,6 +460,22 @@ class ServingEngine:
         self.metrics.episodes_served.inc(len(eps))
         self._note_bucket(eps[0].bucket)
         self.ready = True
+        # Per-episode confidence + nonfinite accounting: pure numpy over
+        # the host logits already fetched above — zero new device syncs,
+        # zero new program signatures (compile-guard-pinned). margins/
+        # entropies/tags feed tools/episode_miner.py's hard-episode
+        # feedback loop; the nonfinite counter is the /metrics signal the
+        # promotion daemon's post-publish SLO watch rolls back on.
+        margins, entropies, nonfinite = [], [], 0
+        for i in range(len(eps)):
+            row = host[i]
+            if not np.isfinite(row).all():
+                nonfinite += 1
+            margin, entropy = confidence_stats(row)
+            margins.append(margin)
+            entropies.append(entropy)
+        if nonfinite:
+            self.metrics.nonfinite_logits_total.inc(nonfinite)
         with self._compiles_lock:
             self._dispatch_seq += 1
             dispatch_id = self._dispatch_seq
@@ -429,6 +488,10 @@ class ServingEngine:
             adapt_ms=adapt_ms,
             classify_ms=classify_ms,
             n_devices=self._n_devices,
+            margins=margins,
+            entropies=entropies,
+            tags=[ep.tag for ep in eps],
+            nonfinite=nonfinite,
         )
         return [host[i] for i in range(len(eps))]
 

@@ -49,6 +49,12 @@ class ServeMetrics:
         self.deadline_exceeded_total = Counter("deadline_exceeded_total")
         self.swaps_total = Counter("swaps_total")
         self.swap_rejected_total = Counter("swap_rejected_total")
+        # Episodes whose host logits carried any non-finite value — the
+        # live numeric-regression signal the promotion daemon's
+        # post-publish SLO watch triggers rollback on (a canary can only
+        # prove the candidate BEFORE publish; this counter watches it
+        # under real traffic after).
+        self.nonfinite_logits_total = Counter("nonfinite_logits_total")
         self.degraded = Gauge("degraded")
         # bucket key -> {"dispatches": int, "episodes": int}; compile counts
         # live with the engine (it owns the jit boundary) and are merged
@@ -86,6 +92,7 @@ class ServeMetrics:
             "deadline_exceeded_total": self.deadline_exceeded_total.value,
             "swaps_total": self.swaps_total.value,
             "swap_rejected_total": self.swap_rejected_total.value,
+            "nonfinite_logits_total": self.nonfinite_logits_total.value,
             "degraded": bool(self.degraded.value),
             "queue_depth": queue_depth,
             "cache": {
@@ -128,6 +135,8 @@ class ServeMetrics:
             f"{p}_swaps_total {self.swaps_total.value}",
             f"# TYPE {p}_swap_rejected_total counter",
             f"{p}_swap_rejected_total {self.swap_rejected_total.value}",
+            f"# TYPE {p}_nonfinite_logits_total counter",
+            f"{p}_nonfinite_logits_total {self.nonfinite_logits_total.value}",
             f"# TYPE {p}_degraded gauge",
             f"{p}_degraded {int(self.degraded.value)}",
             f"# TYPE {p}_queue_depth gauge",

@@ -38,8 +38,15 @@ import dataclasses
 import threading
 import time
 
+import numpy as np
+
 from ..telemetry import events as telemetry_events
-from ..utils.checkpoint import CheckpointError, verify_checkpoint
+from ..utils import faultinject
+from ..utils.checkpoint import (
+    CheckpointError,
+    checkpoint_digest,
+    verify_checkpoint,
+)
 from .errors import (
     NoHealthyReplicaError,
     ReplicaDeadError,
@@ -132,6 +139,12 @@ class PoolMetrics:
         self.replica_deaths_total = Counter("replica_deaths_total")
         self.replica_restarts_total = Counter("replica_restarts_total")
         self.circuit_open_total = Counter("circuit_open_total")
+        # Answered requests whose logits carried any non-finite value —
+        # counted at the front door (works for subprocess replicas too,
+        # whose engine-level counters the pool cannot scrape), so the
+        # promotion daemon's post-publish SLO watch sees live numeric
+        # regressions on ONE /metrics surface.
+        self.nonfinite_logits_total = Counter("nonfinite_logits_total")
         self.request_latency = LatencyStat("request")
 
 
@@ -155,6 +168,11 @@ class ReplicaPool:
         self._rr = 0  # round-robin cursor
         self._graveyard: list[Replica] = []  # terminated by the supervisor
         self._closed = False
+        #: Provenance of the last fleet-wide promotion (content digest +
+        #: source path) — /healthz surfaces it so a crashed promotion
+        #: daemon can resume idempotently (was my in-flight candidate
+        #: already published?).
+        self._last_promoted: dict | None = None
         for slot in self._slots:
             self._try_start(slot)
         self._supervisor = threading.Thread(
@@ -182,7 +200,8 @@ class ReplicaPool:
             return slot, slot.replica
 
     def classify(
-        self, x_support, y_support, x_query, *, timeout: float | None = 30.0
+        self, x_support, y_support, x_query, *,
+        timeout: float | None = 30.0, tag: str | None = None,
     ) -> dict:
         """Dispatches one episode to a healthy replica, re-dispatching on
         replica death (bounded by ``max_dispatch_retries``). Raises
@@ -215,9 +234,12 @@ class ReplicaPool:
                         )
                     per_attempt = min(per_attempt, remaining)
                 try:
-                    return replica.classify(
-                        x_support, y_support, x_query, timeout=per_attempt
+                    result = replica.classify(
+                        x_support, y_support, x_query, timeout=per_attempt,
+                        tag=tag,
                     )
+                    self._note_logits(result)
+                    return result
                 except ReplicaDeadError as exc:
                     last_death = exc
                     self._report_death(slot, replica)
@@ -238,6 +260,21 @@ class ReplicaPool:
             self.metrics.request_latency.observe(
                 (time.perf_counter() - t0) * 1e3
             )
+
+    def _note_logits(self, result: dict) -> None:
+        """Front-door nonfinite accounting (the SLO-watch scrape works
+        for subprocess replicas too, whose engine counters the pool
+        cannot see). Strictly best-effort: a malformed logits field must
+        never fail a response that the replica answered."""
+        logits = result.get("logits") if isinstance(result, dict) else None
+        if logits is None:
+            return
+        try:
+            finite = np.isfinite(np.asarray(logits, np.float64)).all()
+        except (TypeError, ValueError):
+            return
+        if not finite:
+            self.metrics.nonfinite_logits_total.inc()
 
     def _report_death(self, slot: _Slot, replica: Replica) -> None:
         """Fast-path retirement from the dispatch side: a dropped
@@ -397,11 +434,15 @@ class ReplicaPool:
     def healthz(self) -> dict:
         with self._lock:
             replicas = [slot.describe() for slot in self._slots]
+            last_promoted = dict(self._last_promoted or {}) or None
         healthy = sum(1 for r in replicas if r["state"] == HEALTHY)
         size = len(replicas)
         degraded = healthy < size
         ready = healthy > 0
         return {
+            "last_promoted_digest": (
+                last_promoted["digest"] if last_promoted else None
+            ),
             "status": (
                 "ok" if not degraded else ("degraded" if ready else "unready")
             ),
@@ -465,9 +506,19 @@ class ReplicaPool:
                     reason=exc.reason,
                 ) from exc
             promoted += 1
+        with self._lock:
+            self._last_promoted = {
+                "digest": checkpoint_digest(checkpoint_path),
+                "path": checkpoint_path,
+                "t": time.time(),
+            }
         telemetry_events.emit(
             "pool_swap_promoted", source=checkpoint_path, replicas=promoted,
         )
+        # The post-publish regression fault arms here: the publish just
+        # landed, so an injected live regression begins with the very
+        # next answered request (utils/faultinject.py).
+        faultinject.promotion_applied()
         return {
             "promoted_replicas": promoted,
             "state_version": result.get("state_version"),
@@ -483,6 +534,7 @@ class ReplicaPool:
             "replica_deaths_total": m.replica_deaths_total.value,
             "replica_restarts_total": m.replica_restarts_total.value,
             "circuit_open_total": m.circuit_open_total.value,
+            "nonfinite_logits_total": m.nonfinite_logits_total.value,
             "latency_ms": {"request": m.request_latency.snapshot()},
             "replicas": self.healthz()["replicas"],
         }
@@ -506,6 +558,8 @@ class ReplicaPool:
             f"{p}_replica_restarts_total {m.replica_restarts_total.value}",
             f"# TYPE {p}_circuit_open_total counter",
             f"{p}_circuit_open_total {m.circuit_open_total.value}",
+            f"# TYPE {p}_nonfinite_logits_total counter",
+            f"{p}_nonfinite_logits_total {m.nonfinite_logits_total.value}",
             f"# TYPE {p}_healthy_replicas gauge",
             f"{p}_healthy_replicas {health['healthy_replicas']}",
             f"# TYPE {p}_degraded gauge",

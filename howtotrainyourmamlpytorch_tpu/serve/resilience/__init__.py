@@ -19,6 +19,12 @@ divergence sentinel; the serving runtime gets the equivalent four:
   crash-restarted with exponential backoff and a crash-loop circuit
   breaker, with in-flight requests re-dispatched to healthy replicas
   (``serve_adapt``/``serve_classify`` are pure, so retry is idempotent).
+* ``promotion`` — the continuous train→serve control plane: a journal-
+  backed daemon that watches the trainer's checkpoint directory, stages
+  + verifies + val-gates candidates, drives the canary-first pool
+  promote with retry/backoff, and rolls back automatically when the
+  post-publish SLO watch sees live regression (``tools/
+  promotion_daemon.py`` is the CLI).
 
 Every recovery path is proven by deterministic fault injection
 (``utils/faultinject.py``: ``replica_kill_at_request``,
@@ -33,6 +39,12 @@ from .replica import (
     Replica,
     SubprocessReplica,
 )
+from .promotion import (
+    PromotionConfig,
+    PromotionDaemon,
+    PromotionJournal,
+    SloWatch,
+)
 from .swap import SwapResult, promote_checkpoint, promote_state
 
 __all__ = [
@@ -44,4 +56,8 @@ __all__ = [
     "SwapResult",
     "promote_checkpoint",
     "promote_state",
+    "PromotionConfig",
+    "PromotionDaemon",
+    "PromotionJournal",
+    "SloWatch",
 ]

@@ -1,0 +1,936 @@
+"""Continuous train→serve promotion control plane.
+
+PR 6 gave the fleet a SAFE promote verb (manifest verify → canary →
+publish, ``serve/resilience/swap.py``); PR 10 gave the trainer an async
+checkpoint publisher. This module closes the loop between them: a
+supervisor that watches the trainer's checkpoint directory and drives
+promotions across the serving fleet unattended — which makes it a
+robustness problem first. Every automated promotion is an unattended
+state change to live traffic, so the daemon is built around three
+contracts:
+
+* **candidate gating** — an epoch checkpoint becomes a candidate only
+  once its ``.ready`` done-marker exists AND the marker's content digest
+  matches the file (``utils/checkpoint.publish_done_marker`` writes the
+  marker LAST, so a watcher can never pick up a torn publish); the
+  candidate is then STAGED (a REAL copy into the daemon's retention dir
+  — never a hardlink, so no staged artifact shares an inode with the
+  trainer's files, and the trainer pruning old epochs cannot strand a
+  rollback target), integrity-verified (``verify_checkpoint``), val-gated
+  against the experiment's own recorded statistics before any replica is
+  touched.
+* **crash-safe idempotency** — every phase transition is journaled to an
+  append-only fsync'd JSONL (``logs/promotions.jsonl``) BEFORE/AFTER the
+  action it brackets. SIGKILL the daemon at any boundary, restart it,
+  and replay resumes exactly once: a candidate journaled ``verified``
+  but not ``promoted`` is checked against the fleet's served digest
+  (``/healthz`` ``last_promoted_digest``/``checkpoint_digest``) — if the
+  publish already landed the daemon records ``promoted`` with
+  ``resumed`` set instead of double-promoting; digests with a terminal
+  row are never re-driven (duplicate candidates dedupe by content
+  digest).
+* **automatic rollback** — after publish the daemon watches windowed
+  error-rate / p99 / nonfinite counters scraped from the front door's
+  ``/metrics`` and re-promotes the RETAINED last-known-good staged
+  checkpoint if the new state regresses live traffic — the rollback
+  PR 6's canary cannot provide, because a canary only runs BEFORE
+  publish (``regress_after_promote`` in ``utils/faultinject.py`` is the
+  deterministic proof of exactly that gap).
+
+The daemon owns two threads — the watcher loop and the SLO sampler —
+both joined by ``close()`` (graftlint ``thread-lifecycle``). The CLI
+wrapper is ``tools/promotion_daemon.py``; the chaos proof is
+``tools/chaos_train.py --schedule promote``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+
+from ...telemetry import events as telemetry_events
+from ...utils import faultinject
+from ...utils.checkpoint import (
+    CheckpointError,
+    checkpoint_digest,
+    read_done_marker,
+    verify_checkpoint,
+)
+from ..errors import NoHealthyReplicaError, ReplicaDeadError, SwapRejectedError
+
+#: Journal phase names (one JSONL row each). Terminal phases end a
+#: digest's lifecycle; everything else is resumable after a crash.
+PHASE_START = "start"
+PHASE_VERIFIED = "verified"
+PHASE_PROMOTED = "promoted"
+PHASE_SLO_OK = "slo_ok"
+PHASE_REJECTED = "rejected"
+PHASE_ROLLBACK_START = "rollback_start"
+PHASE_ROLLED_BACK = "rolled_back"
+PHASE_DEDUPED = "deduped"
+PHASE_RESUMED = "resumed"
+
+TERMINAL_PHASES = (PHASE_REJECTED, PHASE_SLO_OK, PHASE_ROLLED_BACK)
+
+#: ``daemon_kill_at_phase`` boundaries (utils/faultinject.py): SIGKILL
+#: here, restart, and the journal replay must change no outcome.
+KILL_PRE_VERIFY = 1  # ``start`` journaled, candidate not yet verified
+KILL_PRE_PUBLISH = 2  # ``verified`` journaled, fleet not yet touched
+KILL_POST_PUBLISH = 3  # fleet promoted, ``promoted`` row not yet written
+KILL_PRE_RESOLVE = 4  # ``promoted`` journaled, SLO watch unresolved
+
+
+class PromotionTransportError(Exception):
+    """The target fleet could not be reached / answered abnormally —
+    transient by assumption; the daemon retries with backoff and leaves
+    the candidate in-flight (journal-resumable), never rejected."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PromotionConfig:
+    """Control-plane knobs (CLI surface: ``tools/promotion_daemon.py``)."""
+
+    #: The trainer's ``saved_models`` directory being watched.
+    watch_dir: str
+    #: Append-only crash-safe journal (``logs/promotions.jsonl``).
+    journal_path: str
+    #: Retention dir for staged candidate copies (rollback targets must
+    #: survive the trainer pruning ``max_models_to_save``).
+    staging_dir: str
+    #: Directory-poll cadence of the watcher loop.
+    poll_interval_s: float = 2.0
+    #: Experiment statistic the val-gate reads (last recorded value;
+    #: falls back to ``best_val_acc`` when the series is absent).
+    val_stat_key: str = "val_accuracy_mean"
+    #: A candidate without a finite recorded val stat is rejected (the
+    #: epoch-0 checkpoint predates any validation epoch by contract).
+    require_val_stat: bool = True
+    #: When set, a candidate must beat the last-known-good's recorded
+    #: stat by at least this much (may be negative to tolerate noise);
+    #: ``None`` disables the comparison (stat presence still gates).
+    val_min_delta: float | None = None
+    #: Publish-drive retry budget for transient fleet errors.
+    promote_retries: int = 3
+    promote_backoff_s: float = 0.5
+    #: Post-publish SLO watch: window length, sample cadence, and the
+    #: regression thresholds over the window's /metrics deltas.
+    slo_watch_s: float = 10.0
+    slo_poll_s: float = 0.5
+    p99_budget_ms: float = 30_000.0
+    max_error_rate: float = 0.05
+    max_new_nonfinite: int = 0
+    #: Minimum answered requests in the window before error-rate/p99
+    #: verdicts apply (a 1-request window must not decide a rollback).
+    min_requests: int = 1
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+
+class PromotionJournal:
+    """Append-only fsync'd JSONL journal — the daemon's crash-safe state.
+
+    Each ``append`` is one fully-flushed line; replay (``load``) tolerates
+    a torn final line (a SIGKILL mid-append loses at most the row being
+    written, and the daemon's resume logic re-derives it from the fleet)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+
+    def append(self, phase: str, **fields) -> dict:
+        row = {"t": time.time(), "phase": str(phase), **fields}
+        line = json.dumps(row)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return row
+
+    @staticmethod
+    def load(path: str) -> list[dict]:
+        rows: list[dict] = []
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            return rows
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn final line from a killed writer
+            if isinstance(row, dict) and row.get("phase"):
+                rows.append(row)
+        return rows
+
+
+def replay_journal(rows: list[dict]) -> dict:
+    """Folds journal rows into the daemon's resume state: per-digest info
+    (path/staged/epoch/val_stat), per-digest last phase, the terminal
+    set, the last-known-good (newest ``slo_ok``), and the in-flight
+    candidate (newest digest whose last phase is non-terminal)."""
+    info: dict[str, dict] = {}
+    last_phase: dict[str, str] = {}
+    lkg: dict | None = None
+    seen_pairs: set[tuple[str, str]] = set()
+    order: list[str] = []
+    for row in rows:
+        digest = row.get("digest")
+        if not digest:
+            continue
+        entry = info.setdefault(digest, {"digest": digest})
+        for key in ("path", "staged", "epoch", "val_stat"):
+            if row.get(key) is not None:
+                entry[key] = row[key]
+        phase = row["phase"]
+        if phase == PHASE_DEDUPED:
+            seen_pairs.add((digest, str(row.get("path"))))
+            continue
+        if digest not in order:
+            order.append(digest)
+        if phase == PHASE_RESUMED:
+            # An audit row, not a lifecycle state: folding it into
+            # last_phase would make a crash AFTER a resume replay the
+            # candidate from scratch (and double-drive a landed publish);
+            # the row already records from_phase for the audit trail.
+            continue
+        last_phase[digest] = phase
+        if phase == PHASE_SLO_OK:
+            lkg = dict(entry)
+    terminal = {d for d, p in last_phase.items() if p in TERMINAL_PHASES}
+    inflight = None
+    for digest in reversed(order):
+        if digest not in terminal:
+            inflight = dict(info[digest])
+            inflight["last_phase"] = last_phase[digest]
+            break
+    return {
+        "info": info,
+        "last_phase": last_phase,
+        "terminal": terminal,
+        "lkg": lkg,
+        "inflight": inflight,
+        "seen_pairs": seen_pairs,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Fleet target (front door)
+# ---------------------------------------------------------------------------
+
+
+class HttpTarget:
+    """Minimal front-door client for the daemon: POST /admin/promote,
+    GET /healthz (503 bodies are health data, not errors), GET /metrics.
+    Transport failures normalize to :class:`PromotionTransportError` so
+    the retry loop has one class to catch. In-process targets (a
+    ``ReplicaPool`` or ``ServingAPI``) are used directly — they already
+    quack promote/healthz/metrics_text."""
+
+    def __init__(self, base_url: str, timeout_s: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _fetch(self, path: str, payload: dict | None = None):
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read()
+
+    def promote(self, checkpoint_path: str) -> dict:
+        try:
+            return json.loads(
+                self._fetch("/admin/promote", {"checkpoint": checkpoint_path})
+            )
+        except urllib.error.HTTPError as exc:
+            body = {}
+            try:
+                body = json.load(exc)
+            except Exception:  # noqa: BLE001 — body is best-effort detail
+                pass
+            if exc.code == 409:
+                raise SwapRejectedError(
+                    body.get("error", str(exc)),
+                    reason=body.get("reason", "canary"),
+                ) from None
+            raise PromotionTransportError(
+                f"promote answered {exc.code}: {body.get('error', exc)}"
+            ) from None
+        except (urllib.error.URLError, ConnectionError, OSError, TimeoutError) as exc:
+            raise PromotionTransportError(f"promote failed: {exc}") from exc
+
+    def healthz(self) -> dict:
+        try:
+            return json.loads(self._fetch("/healthz"))
+        except urllib.error.HTTPError as exc:
+            try:
+                return json.load(exc)  # 503 carries the health body
+            except Exception:  # noqa: BLE001
+                raise PromotionTransportError(
+                    f"healthz answered {exc.code}"
+                ) from None
+        except (urllib.error.URLError, ConnectionError, OSError, TimeoutError) as exc:
+            raise PromotionTransportError(f"healthz failed: {exc}") from exc
+
+    def metrics_text(self) -> str:
+        try:
+            return self._fetch("/metrics").decode()
+        except (urllib.error.URLError, ConnectionError, OSError, TimeoutError) as exc:
+            raise PromotionTransportError(f"metrics failed: {exc}") from exc
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Exposition text -> ``{metric_name_with_labels: value}`` (comments
+    and unparsable lines skipped)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name.strip()] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+#: Front-door metric suffixes the SLO watch reads, tried under the pool
+#: prefix first (a pool front door renders only pool metrics), then the
+#: single-engine prefix.
+_SLO_PREFIXES = ("maml_serve_pool", "maml_serve")
+_SLO_SUFFIXES = {
+    "requests": "_requests_total",
+    "errors": "_request_errors_total",
+    "nonfinite": "_nonfinite_logits_total",
+    "p99_ms": '_request_latency_ms{quantile="0.99"}',
+}
+
+
+def slo_counters(metrics: dict[str, float]) -> dict[str, float] | None:
+    for prefix in _SLO_PREFIXES:
+        if prefix + "_requests_total" in metrics:
+            return {
+                key: float(metrics.get(prefix + suffix, 0.0))
+                for key, suffix in _SLO_SUFFIXES.items()
+            }
+    return None
+
+
+# ---------------------------------------------------------------------------
+# SLO watch
+# ---------------------------------------------------------------------------
+
+
+class SloWatch:
+    """Continuous /metrics sampler with windowed post-publish verdicts.
+
+    A background thread samples the front door's counters on a cadence
+    into a bounded deque; after each publish the daemon anchors a
+    baseline sample and asks for a verdict over the deltas since it.
+    Scrape failures are skipped (a missed sample must not decide a
+    rollback); the verdict needs at least ``min_requests`` answered in
+    the window before error-rate/p99 apply — the nonfinite counter
+    triggers on any delta beyond ``max_new_nonfinite``."""
+
+    def __init__(self, target, config: PromotionConfig):
+        self.target = target
+        self.config = config
+        self._samples: deque[tuple[float, dict]] = deque(maxlen=4096)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="promotion-slo-sampler", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.sample_now()
+            self._stop.wait(self.config.slo_poll_s)
+
+    def sample_now(self) -> dict | None:
+        """One synchronous scrape; returns the counters (also appended to
+        the window) or ``None`` on scrape failure."""
+        try:
+            counters = slo_counters(parse_prometheus(self.target.metrics_text()))
+        except Exception:  # noqa: BLE001 — scrape failure is a skipped sample
+            counters = None
+        if counters is not None:
+            self._samples.append((time.monotonic(), counters))
+        return counters
+
+    def verdict(self, baseline: dict | None) -> str | None:
+        """Regression reason since ``baseline`` (a ``sample_now`` result),
+        or ``None`` while the window looks healthy."""
+        if baseline is None or not self._samples:
+            return None
+        _, now = self._samples[-1]
+        d_requests = now["requests"] - baseline["requests"]
+        d_errors = now["errors"] - baseline["errors"]
+        d_nonfinite = now["nonfinite"] - baseline["nonfinite"]
+        if d_nonfinite > self.config.max_new_nonfinite:
+            return (
+                f"nonfinite logits on live traffic: +{int(d_nonfinite)} "
+                f"(max {self.config.max_new_nonfinite})"
+            )
+        if d_requests >= self.config.min_requests:
+            error_rate = d_errors / d_requests
+            if error_rate > self.config.max_error_rate:
+                return (
+                    f"error rate {error_rate:.3f} over {int(d_requests)} "
+                    f"requests (max {self.config.max_error_rate})"
+                )
+            # The scrape exposes the fleet's ring-buffer p99, not a pure
+            # post-publish window, so require BOTH over-budget AND growth
+            # vs the post-publish baseline — a pre-publish latency spike
+            # still in the ring must not condemn a healthy candidate.
+            # (At low qps the ring moves slowly; the nonfinite and
+            # error-rate deltas are the sharp rollback signals.)
+            if (
+                now["p99_ms"] > self.config.p99_budget_ms
+                and now["p99_ms"] > 1.2 * baseline["p99_ms"]
+            ):
+                return (
+                    f"p99 {now['p99_ms']:.0f} ms over budget "
+                    f"{self.config.p99_budget_ms:.0f} ms (baseline "
+                    f"{baseline['p99_ms']:.0f} ms)"
+                )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Daemon
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Candidate:
+    epoch: int
+    path: str
+    digest: str
+
+
+class PromotionDaemon:
+    """The supervisor: scan → stage → verify/val-gate → promote (retry)
+    → journal → SLO watch → resolve (``slo_ok`` or rollback). One watcher
+    thread; see the module docstring for the three contracts."""
+
+    def __init__(self, target, config: PromotionConfig):
+        self.target = target
+        self.config = config
+        self.journal = PromotionJournal(config.journal_path)
+        self.slo = SloWatch(target, config)
+        os.makedirs(config.staging_dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        state = replay_journal(PromotionJournal.load(config.journal_path))
+        self._info: dict[str, dict] = state["info"]
+        self._terminal: set[str] = set(state["terminal"])
+        self._seen_pairs: set[tuple[str, str]] = set(state["seen_pairs"])
+        self._lkg: dict | None = state["lkg"]
+        self._inflight: dict | None = state["inflight"]
+        #: Count of publishes this daemon RESOLVED (slo_ok or rollback) —
+        #: the ``--max_promotions`` exit condition.
+        self.resolved_promotions = 0
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self.slo.start()
+        self._thread = threading.Thread(
+            target=self._run, name="promotion-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+        self.slo.close()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception as exc:  # noqa: BLE001 — the loop must survive
+                detail = f"{type(exc).__name__}: {exc}"[:300]
+                telemetry_events.emit("promotion_error", error=detail)
+                print(
+                    f"promotion daemon: pass failed ({detail}); retrying "
+                    f"in {self.config.poll_interval_s}s",
+                    file=sys.stderr,
+                )
+            self._flush_telemetry()
+            self._stop.wait(self.config.poll_interval_s)
+
+    @staticmethod
+    def _flush_telemetry() -> None:
+        sink = telemetry_events.active()
+        if sink is not None:
+            sink.flush()
+
+    # -- scan -----------------------------------------------------------
+
+    def scan_candidates(self) -> list[Candidate]:
+        """Fully-published, not-yet-terminal epoch candidates in epoch
+        order. A checkpoint is only visible once its ``.ready`` marker
+        exists AND the marker digest matches the file bytes (the torn-
+        publish protocol); an already-terminal digest surfacing at a NEW
+        path is journaled ``deduped`` once and skipped."""
+        try:
+            names = os.listdir(self.config.watch_dir)
+        except OSError:
+            return []
+        epochs = []
+        for name in names:
+            suffix = name[len("train_model_"):]
+            if name.startswith("train_model_") and suffix.isdigit():
+                epochs.append(int(suffix))
+        out: list[Candidate] = []
+        for epoch in sorted(epochs):
+            path = os.path.join(self.config.watch_dir, f"train_model_{epoch}")
+            marker = read_done_marker(path)
+            if marker is None:
+                continue  # not fully published yet (or torn) — wait
+            digest = str(marker["digest"])
+            if digest in self._terminal or (
+                self._inflight and self._inflight.get("digest") == digest
+            ):
+                pair = (digest, path)
+                if digest in self._terminal and pair not in self._seen_pairs:
+                    known = self._info.get(digest, {})
+                    if known.get("path") != path:
+                        self._seen_pairs.add(pair)
+                        self.journal.append(
+                            PHASE_DEDUPED, digest=digest, path=path
+                        )
+                continue
+            if digest in self._info and self._info[digest].get("resolved"):
+                continue
+            out.append(Candidate(epoch=epoch, path=path, digest=digest))
+        return out
+
+    # -- one pass -------------------------------------------------------
+
+    def run_once(self) -> None:
+        """One watcher pass: resume any journaled in-flight candidate,
+        then process new candidates in epoch order."""
+        if self._inflight is not None:
+            self._resume_inflight()
+        for cand in self.scan_candidates():
+            if self._stop.is_set():
+                return
+            self._process(cand)
+
+    # -- candidate pipeline --------------------------------------------
+
+    def _note_phase(self, phase: str, **fields) -> None:
+        """Keeps the in-memory in-flight record aligned with the journal,
+        so a transient failure retried in THE SAME process resumes from
+        the right phase (cross-process restarts rebuild it by replay)."""
+        if self._inflight is not None:
+            self._inflight["last_phase"] = phase
+            self._inflight.update(
+                {k: v for k, v in fields.items() if v is not None}
+            )
+
+    def _staged_path(self, cand: Candidate) -> str:
+        return os.path.join(
+            self.config.staging_dir,
+            f"{cand.digest[:16]}_{os.path.basename(cand.path)}",
+        )
+
+    def _stage(self, cand: Candidate) -> str:
+        staged = self._staged_path(cand)
+        if not os.path.exists(staged):
+            _copy_atomic(cand.path, staged)
+        return staged
+
+    def _verify(self, cand: Candidate, staged: str):
+        """Integrity + val-gate on the STAGED copy. Returns
+        ``(val_stat, None)`` on acceptance, ``(None, (reason, detail))``
+        on rejection."""
+        faultinject.candidate_checkpoint_loading(staged)
+        try:
+            if checkpoint_digest(staged) != cand.digest:
+                return None, (
+                    "digest_mismatch",
+                    "staged bytes disagree with the publish marker digest",
+                )
+            summary = verify_checkpoint(staged)
+        except CheckpointError as exc:
+            return None, ("corrupt", str(exc))
+        val_stat = extract_val_stat(
+            summary.get("experiment_state") or {}, self.config.val_stat_key
+        )
+        if val_stat is None and self.config.require_val_stat:
+            return None, (
+                "val_gate",
+                f"no finite {self.config.val_stat_key!r} recorded in the "
+                "candidate's experiment state",
+            )
+        if (
+            self.config.val_min_delta is not None
+            and val_stat is not None
+            and self._lkg is not None
+            and self._lkg.get("val_stat") is not None
+            and val_stat < float(self._lkg["val_stat"]) + self.config.val_min_delta
+        ):
+            return None, (
+                "val_gate",
+                f"{self.config.val_stat_key}={val_stat:.4f} does not beat "
+                f"last-known-good {float(self._lkg['val_stat']):.4f} "
+                f"by {self.config.val_min_delta}",
+            )
+        return val_stat, None
+
+    def _reject(self, digest: str, path: str, reason: str, detail: str) -> None:
+        self._terminal.add(digest)
+        self._inflight = None
+        self.journal.append(
+            PHASE_REJECTED, digest=digest, path=path,
+            reason=reason, detail=detail[:300],
+        )
+        telemetry_events.emit(
+            "promotion_rejected", digest=digest[:16], source=path,
+            reason=reason, detail=detail[:300],
+        )
+
+    def _drive_promote(self, staged: str) -> int | None:
+        """Drives ``target.promote`` with transient-error retry/backoff;
+        returns the fleet's new state version. ``SwapRejectedError``
+        propagates (terminal rejection); exhausted transient retries
+        raise :class:`PromotionTransportError` (candidate stays
+        in-flight and journal-resumable)."""
+        last: Exception | None = None
+        for attempt in range(max(int(self.config.promote_retries), 1)):
+            if attempt:
+                if self._stop.wait(
+                    self.config.promote_backoff_s * (2 ** (attempt - 1))
+                ):
+                    break
+            try:
+                result = self.target.promote(staged)
+                return (result or {}).get("state_version")
+            except SwapRejectedError:
+                raise
+            except (
+                PromotionTransportError, ReplicaDeadError,
+                NoHealthyReplicaError, ConnectionError, TimeoutError, OSError,
+            ) as exc:
+                last = exc
+        raise PromotionTransportError(
+            f"fleet unreachable after {self.config.promote_retries} "
+            f"attempt(s): {last}"
+        )
+
+    def _process(self, cand: Candidate) -> None:
+        staged = self._stage(cand)
+        info = {
+            "digest": cand.digest, "path": cand.path,
+            "staged": staged, "epoch": cand.epoch,
+        }
+        self._info[cand.digest] = dict(info)
+        self._inflight = dict(info, last_phase=PHASE_START)
+        self.journal.append(PHASE_START, **info)
+        faultinject.daemon_phase(KILL_PRE_VERIFY)
+        val_stat, rejection = self._verify(cand, staged)
+        if rejection is not None:
+            self._reject(cand.digest, cand.path, *rejection)
+            return
+        self._info[cand.digest]["val_stat"] = val_stat
+        self.journal.append(
+            PHASE_VERIFIED, digest=cand.digest, val_stat=val_stat
+        )
+        self._note_phase(PHASE_VERIFIED, val_stat=val_stat)
+        faultinject.daemon_phase(KILL_PRE_PUBLISH)
+        self._publish_and_resolve(cand.digest, staged, val_stat)
+
+    def _publish_and_resolve(
+        self, digest: str, staged: str, val_stat, resumed: bool = False
+    ) -> None:
+        try:
+            version = self._drive_promote(staged)
+        except SwapRejectedError as exc:
+            self._reject(digest, staged, exc.reason, str(exc))
+            return
+        faultinject.daemon_phase(KILL_POST_PUBLISH)
+        self.journal.append(
+            PHASE_PROMOTED, digest=digest, state_version=version,
+            resumed=resumed,
+        )
+        self._note_phase(PHASE_PROMOTED)
+        telemetry_events.emit(
+            "promotion_promoted", digest=digest[:16], source=staged,
+            state_version=version, resumed=resumed,
+        )
+        faultinject.daemon_phase(KILL_PRE_RESOLVE)
+        self._watch_and_resolve(digest, staged, val_stat)
+
+    # -- SLO watch + rollback ------------------------------------------
+
+    def _watch_and_resolve(self, digest: str, staged: str, val_stat) -> None:
+        baseline = self.slo.sample_now()
+        deadline = time.monotonic() + self.config.slo_watch_s
+        reason: str | None = None
+        while time.monotonic() < deadline and not self._stop.is_set():
+            self._stop.wait(self.config.slo_poll_s)
+            if baseline is None:
+                # The post-publish baseline scrape failed (front door
+                # momentarily saturated by the swap): keep trying — a
+                # missing baseline must never vacuously bless the window.
+                baseline = self.slo.sample_now()
+                continue
+            # Sample here too: the watch must not depend on the background
+            # sampler being alive (run_once / --once drive it directly).
+            self.slo.sample_now()
+            reason = self.slo.verdict(baseline)
+            if reason is not None:
+                break
+        if baseline is None:
+            # The whole window passed unscrapeable: leave the candidate
+            # journaled ``promoted`` (in-flight) — the next pass re-judges
+            # a full window instead of recording ``slo_ok`` blind.
+            return
+        if reason is None:
+            if self._stop.is_set():
+                # Shutdown interrupted the watch: leave the candidate
+                # journaled ``promoted`` (in-flight) — the next daemon
+                # run resumes and judges a FULL window instead of
+                # blessing a partial one.
+                return
+            self.slo.sample_now()
+            reason = self.slo.verdict(baseline)
+        if reason is None:
+            self._terminal.add(digest)
+            self._inflight = None
+            self._info[digest]["resolved"] = True
+            self.journal.append(PHASE_SLO_OK, digest=digest)
+            self._lkg = {
+                "digest": digest, "staged": staged, "val_stat": val_stat,
+            }
+            self.resolved_promotions += 1
+            self._gc_staging()
+            return
+        telemetry_events.emit(
+            "slo_regression", digest=digest[:16], reason=reason
+        )
+        rollback_to = self._lkg if (
+            self._lkg and self._lkg.get("digest") != digest
+        ) else None
+        self.journal.append(
+            PHASE_ROLLBACK_START, digest=digest, reason=reason,
+            to=(rollback_to or {}).get("digest"),
+        )
+        self._note_phase(PHASE_ROLLBACK_START)
+        self._finish_rollback(digest, rollback_to, reason)
+
+    def _finish_rollback(self, digest: str, rollback_to, reason: str) -> None:
+        """Drives the rollback promote and resolves the condemned digest.
+        With no distinct last-known-good (a first-ever promotion
+        regressed) there is nothing to roll to: the journal row records
+        ``no_lkg`` and a LOUD ``slo_rollback_unavailable`` event fires —
+        the fleet is still serving the condemned state and an operator
+        must intervene; a phantom "rolled back" must never be claimed."""
+        if rollback_to is not None:
+            self._drive_promote(rollback_to["staged"])
+        self._terminal.add(digest)
+        self._inflight = None
+        self._info.setdefault(digest, {})["resolved"] = True
+        self.journal.append(
+            PHASE_ROLLED_BACK, digest=digest,
+            to=(rollback_to or {}).get("digest"),
+            no_lkg=rollback_to is None,
+        )
+        if rollback_to is None:
+            telemetry_events.emit(
+                "slo_rollback_unavailable", digest=digest[:16], reason=reason
+            )
+            print(
+                f"promotion daemon: digest {digest[:16]} regressed but NO "
+                "last-known-good is retained — the fleet is still serving "
+                "the condemned state; operator intervention required",
+                file=sys.stderr,
+            )
+        else:
+            telemetry_events.emit(
+                "slo_rollback", digest=digest[:16],
+                to=(rollback_to.get("digest") or "")[:16] or None,
+                reason=reason,
+            )
+        self.resolved_promotions += 1
+        self._gc_staging()
+
+    def _gc_staging(self) -> None:
+        """Drops staged copies whose lifecycle resolved and which are not
+        the retained last-known-good — retention is exactly what rollback
+        needs, nothing more."""
+        keep = set()
+        if self._lkg:
+            keep.add(os.path.basename(str(self._lkg.get("staged"))))
+        if self._inflight:
+            keep.add(os.path.basename(str(self._inflight.get("staged"))))
+        try:
+            names = os.listdir(self.config.staging_dir)
+        except OSError:
+            return
+        for name in names:
+            if name in keep:
+                continue
+            try:
+                os.remove(os.path.join(self.config.staging_dir, name))
+            except OSError:
+                pass
+
+    # -- crash resume ---------------------------------------------------
+
+    def _fleet_digest(self) -> str | None:
+        """The fleet's served promotion digest: ``None`` = UNREACHABLE
+        (the caller must not decide anything on it), ``""`` = reachable
+        but nothing promoted yet, else the digest string."""
+        try:
+            health = self.target.healthz()
+        except Exception:  # noqa: BLE001 — fleet unreachable: decide later
+            return None
+        return (
+            health.get("last_promoted_digest")
+            or health.get("checkpoint_digest")
+            or ""
+        )
+
+    def _resume_inflight(self) -> None:
+        """Journal-replay resume: exactly-once semantics at every kill
+        boundary. ``start`` → re-verify from the staged copy; ``verified``
+        → ask the fleet whether the publish already landed (SIGKILL
+        between publish and the ``promoted`` row) and either record it as
+        resumed or drive it now; ``promoted``/``rollback_start`` → redo
+        the unresolved SLO watch / rollback with a fresh window."""
+        inflight = self._inflight
+        if inflight is None:
+            return
+        digest = inflight["digest"]
+        phase = inflight.get("last_phase", PHASE_START)
+        staged = inflight.get("staged") or self._staged_path(
+            Candidate(
+                epoch=int(inflight.get("epoch", 0)),
+                path=str(inflight.get("path")), digest=digest,
+            )
+        )
+        if not os.path.exists(staged):
+            source = str(inflight.get("path") or "")
+            if source and os.path.exists(source):
+                _copy_atomic(source, staged)
+            else:
+                self._reject(
+                    digest, source, "staged_lost",
+                    "daemon restarted with neither the staged copy nor the "
+                    "source checkpoint on disk",
+                )
+                return
+        self.journal.append(PHASE_RESUMED, digest=digest, from_phase=phase)
+        telemetry_events.emit(
+            "promotion_resumed", digest=digest[:16], from_phase=phase
+        )
+        val_stat = inflight.get("val_stat")
+        if phase == PHASE_START:
+            cand = Candidate(
+                epoch=int(inflight.get("epoch", 0)),
+                path=str(inflight.get("path")), digest=digest,
+            )
+            val_stat, rejection = self._verify(cand, staged)
+            if rejection is not None:
+                self._reject(digest, cand.path, *rejection)
+                return
+            self._info.setdefault(cand.digest, {})["val_stat"] = val_stat
+            self.journal.append(
+                PHASE_VERIFIED, digest=digest, val_stat=val_stat
+            )
+            self._publish_and_resolve(digest, staged, val_stat)
+        elif phase == PHASE_VERIFIED:
+            fleet = self._fleet_digest()
+            if fleet is None:
+                # Fleet unreachable right now: we cannot tell whether the
+                # pre-crash publish landed — deciding blind risks a
+                # double-drive. Leave the candidate in-flight; the next
+                # pass asks again.
+                return
+            if fleet == digest:
+                # Published before the crash: record, never double-drive.
+                self.journal.append(
+                    PHASE_PROMOTED, digest=digest, state_version=None,
+                    resumed=True,
+                )
+                telemetry_events.emit(
+                    "promotion_promoted", digest=digest[:16], source=staged,
+                    state_version=None, resumed=True,
+                )
+                self._watch_and_resolve(digest, staged, val_stat)
+            else:
+                self._publish_and_resolve(
+                    digest, staged, val_stat, resumed=True
+                )
+        elif phase == PHASE_PROMOTED:
+            self._watch_and_resolve(digest, staged, val_stat)
+        elif phase == PHASE_ROLLBACK_START:
+            # The regression verdict is already journaled: never re-watch
+            # (the one-shot regression may have passed — re-judging could
+            # bless the digest the daemon already condemned); finish the
+            # rollback drive instead.
+            rollback_to = self._lkg if (
+                self._lkg and self._lkg.get("digest") != digest
+            ) else None
+            self._finish_rollback(digest, rollback_to, "resumed")
+        else:  # unknown phase (newer journal?) — leave it for the operator
+            self._inflight = None
+
+
+def _copy_atomic(src: str, dst: str) -> None:
+    """Stage by REAL copy (tmp + rename), never hardlink: the staged
+    artifact must share no inode with the trainer's file, so daemon-side
+    corruption (``corrupt_candidate_at``) or retention can never reach
+    back into the training run's own checkpoints."""
+    tmp = dst + ".tmp"
+    shutil.copyfile(src, tmp)
+    os.replace(tmp, dst)
+
+
+def extract_val_stat(experiment_state: dict, key: str) -> float | None:
+    """The candidate's recorded validation statistic: last entry of the
+    ``per_epoch_statistics`` series under ``key``, falling back to
+    ``best_val_acc``; ``None`` when absent or non-finite."""
+    stats = experiment_state.get("per_epoch_statistics") or {}
+    values = stats.get(key) or []
+    value = values[-1] if values else experiment_state.get("best_val_acc")
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if math.isfinite(value) else None

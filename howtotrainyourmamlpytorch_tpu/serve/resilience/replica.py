@@ -51,7 +51,8 @@ class Replica:
 
     replica_id: str = "?"
 
-    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+    def classify(self, x_support, y_support, x_query, *, timeout: float,
+                 tag: str | None = None) -> dict:
         raise NotImplementedError
 
     def healthz(self, *, timeout: float) -> dict:
@@ -85,7 +86,8 @@ class LocalReplica(Replica):
         elif fault == "wedge":
             self._wedged = True
 
-    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+    def classify(self, x_support, y_support, x_query, *, timeout: float,
+                 tag: str | None = None) -> dict:
         if self._dead:
             raise ReplicaDeadError(f"replica {self.replica_id} is dead")
         if self._wedged:
@@ -104,7 +106,7 @@ class LocalReplica(Replica):
         # supervisor's health probes must be what detects it, exactly like
         # a process that goes quiet between requests).
         return self.api.classify(
-            x_support, y_support, x_query, timeout=timeout
+            x_support, y_support, x_query, timeout=timeout, tag=tag
         )
 
     def healthz(self, *, timeout: float) -> dict:
@@ -182,12 +184,15 @@ class HttpReplica(Replica):
                 f"replica {self.replica_id} unreachable: {exc}"
             ) from exc
 
-    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+    def classify(self, x_support, y_support, x_query, *, timeout: float,
+                 tag: str | None = None) -> dict:
         payload = {
             "support": np.asarray(x_support).tolist(),
             "support_labels": np.asarray(y_support).tolist(),
             "query": np.asarray(x_query).tolist(),
         }
+        if tag is not None:
+            payload["tag"] = str(tag)
         return self._request("/v1/episode", payload, timeout)
 
     def healthz(self, *, timeout: float) -> dict:
@@ -269,10 +274,11 @@ class SubprocessReplica(Replica):
                 f"{self._proc.returncode}"
             )
 
-    def classify(self, x_support, y_support, x_query, *, timeout: float) -> dict:
+    def classify(self, x_support, y_support, x_query, *, timeout: float,
+                 tag: str | None = None) -> dict:
         self._check_process()
         return self._endpoint(timeout).classify(
-            x_support, y_support, x_query, timeout=timeout
+            x_support, y_support, x_query, timeout=timeout, tag=tag
         )
 
     def healthz(self, *, timeout: float) -> dict:
@@ -316,6 +322,7 @@ def serve_maml_argv(
     checkpoint: str | None = None,
     learner: str = "maml",
     warmup: str = "",
+    telemetry: str | None = None,
     max_batch: int = 4,
     max_wait_ms: float = 2.0,
     cache_capacity: int | None = None,
@@ -353,6 +360,8 @@ def serve_maml_argv(
             argv += [flag, str(value)]
     if warmup:
         argv += ["--warmup", warmup]
+    if telemetry:
+        argv += ["--telemetry", telemetry]
     if checkpoint:
         argv += ["--checkpoint", checkpoint]
     else:

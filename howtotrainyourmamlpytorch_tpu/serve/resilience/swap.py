@@ -29,7 +29,7 @@ import dataclasses
 
 from ...telemetry import events as telemetry_events
 from ...utils import faultinject
-from ...utils.checkpoint import CheckpointError
+from ...utils.checkpoint import CheckpointError, checkpoint_digest
 from ..engine import ServingEngine
 from ..errors import SwapRejectedError
 
@@ -125,6 +125,12 @@ def promote_checkpoint(
             f"checkpoint does not match the served architecture: {exc}",
             reason="incompatible_checkpoint",
         ) from exc
-    return promote_state(
+    result = promote_state(
         engine, state, buckets=buckets, source=checkpoint_path
     )
+    # Provenance for the control plane: the content digest of what is now
+    # serving, surfaced via /healthz so a crashed promotion daemon can
+    # tell on restart whether its in-flight candidate already published.
+    engine.published_digest = checkpoint_digest(checkpoint_path)
+    engine.published_source = checkpoint_path
+    return result

@@ -41,6 +41,7 @@ resume, ``README.md:91-93`` — this layer makes that mechanical):
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
@@ -276,11 +277,14 @@ class AsyncCheckpointWriter:
         *,
         retries: int = WRITE_RETRIES,
         backoff_s: float = WRITE_BACKOFF_S,
+        publish_marker: bool = False,
     ) -> None:
-        """Enqueues one write (plus optional ``latest``-alias publish).
-        Blocks while ``max_pending`` jobs are in flight; raises any earlier
-        writer error first (so a failed epoch write surfaces at the next
-        boundary, exactly like the sync path's raise)."""
+        """Enqueues one write (plus optional ``latest``-alias publish and,
+        with ``publish_marker``, the ``.ready`` done-marker that makes the
+        checkpoint watcher-visible — written LAST, after archive and
+        alias). Blocks while ``max_pending`` jobs are in flight; raises
+        any earlier writer error first (so a failed epoch write surfaces
+        at the next boundary, exactly like the sync path's raise)."""
         self._raise_pending_error()
         with self._cond:
             if self._closed:
@@ -295,7 +299,10 @@ class AsyncCheckpointWriter:
                     "AsyncCheckpointWriter closed while waiting to submit "
                     f"{filepath}"
                 )
-            self._jobs.append((filepath, snapshot, alias_dst, retries, backoff_s))
+            self._jobs.append(
+                (filepath, snapshot, alias_dst, retries, backoff_s,
+                 publish_marker)
+            )
             self._cond.notify_all()
 
     def drain(
@@ -347,7 +354,7 @@ class AsyncCheckpointWriter:
                     self._cond.wait()
                 if self._closed and not self._jobs:
                     return
-                filepath, snapshot, alias_dst, retries, backoff_s = (
+                filepath, snapshot, alias_dst, retries, backoff_s, marker = (
                     self._jobs.pop(0)
                 )
                 self._busy = True
@@ -360,6 +367,10 @@ class AsyncCheckpointWriter:
                     publish_alias(
                         filepath, alias_dst, retries=retries,
                         backoff_s=backoff_s,
+                    )
+                if marker:
+                    publish_done_marker(
+                        filepath, retries=retries, backoff_s=backoff_s
                     )
             except BaseException as exc:  # noqa: BLE001 — surfaced at drain
                 with self._cond:
@@ -426,6 +437,123 @@ def publish_alias(
         duration_s=time.perf_counter() - t_start,
     )
     return dst
+
+
+#: Suffix of the publish done-marker (``train_model_<e>.ready``). A
+#: directory watcher must treat an epoch checkpoint as published ONLY once
+#: this marker exists and its recorded digest matches the file — the
+#: marker is written LAST (rename-last ordering), so the torn window
+#: between archive rename, alias publish and marker can never hand a
+#: watcher a half-published candidate. ``.ready`` is not ``.isdigit()``,
+#: so the builder's own resume scan ignores markers.
+READY_MARKER_SUFFIX = ".ready"
+
+#: Bump when the marker payload changes incompatibly.
+MARKER_SCHEMA_VERSION = 1
+
+
+#: (path, mtime_ns, size) -> sha256 memo. One hot promotion otherwise
+#: re-hashes the same multi-GB archive several times (publish marker,
+#: daemon verify, per-replica swap provenance, pool provenance); every
+#: publish path lands a NEW inode via atomic rename, so mtime+size key
+#: the bytes faithfully. Bounded small; entries cycle with the run.
+_DIGEST_MEMO: dict = {}
+_DIGEST_MEMO_MAX = 64
+
+
+def checkpoint_digest(filepath: str) -> str:
+    """sha256 hex of the archive bytes — the manifest digest the promotion
+    control plane dedupes and journals on. Content-addressed on the FILE
+    (not the manifest JSON alone): two byte-identical publishes of the
+    same epoch (e.g. a kill-mid-publish replay) collapse to one candidate,
+    and any post-publish mutation shows up as a marker mismatch. Memoized
+    per (path, mtime, size) so one promotion does not re-hash the same
+    archive at every stage of the pipeline."""
+    stat = os.stat(filepath)
+    key = (os.path.abspath(filepath), stat.st_mtime_ns, stat.st_size)
+    hit = _DIGEST_MEMO.get(key)
+    if hit is not None:
+        return hit
+    digest = hashlib.sha256()
+    with open(filepath, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    out = digest.hexdigest()
+    if len(_DIGEST_MEMO) >= _DIGEST_MEMO_MAX:
+        _DIGEST_MEMO.pop(next(iter(_DIGEST_MEMO)))
+    _DIGEST_MEMO[key] = out
+    return out
+
+
+def publish_done_marker(
+    filepath: str,
+    *,
+    retries: int = WRITE_RETRIES,
+    backoff_s: float = WRITE_BACKOFF_S,
+) -> str:
+    """Publishes ``<filepath>.ready`` (atomic tmp+rename, same transient-
+    ``OSError`` retry budget as every other publish half) recording the
+    archive's content digest — the LAST step of an epoch-checkpoint
+    publish, so watchers only ever observe fully-settled candidates.
+    The ``kill_trainer_mid_publish`` fault fires here (before the marker
+    exists): the archive is on disk, the marker is not — the exact torn
+    window the marker protocol closes."""
+    faultinject.trainer_publish_marker(filepath)
+    t_start = time.perf_counter()
+    payload = json.dumps(
+        {
+            "schema": MARKER_SCHEMA_VERSION,
+            "digest": checkpoint_digest(filepath),
+            "bytes": os.path.getsize(filepath),
+        }
+    )
+    marker = filepath + READY_MARKER_SUFFIX
+    tmp = marker + ".tmp"
+    last_error: OSError | None = None
+    for attempt in range(max(int(retries), 1)):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            faultinject.checkpoint_write_attempt(marker)
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, marker)
+            last_error = None
+            break
+        except OSError as exc:
+            last_error = exc
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if last_error is not None:
+        raise last_error
+    telemetry_events.emit(
+        "checkpoint_ready",
+        path=os.path.basename(filepath),
+        duration_s=time.perf_counter() - t_start,
+    )
+    return marker
+
+
+def read_done_marker(filepath: str) -> dict | None:
+    """The watcher side of the marker protocol: returns the marker payload
+    for checkpoint ``filepath`` — ``None`` when the marker is missing,
+    torn, or from a newer schema (all mean "not yet published" to a
+    watcher; never an exception — a daemon poll must not crash on a
+    marker mid-write)."""
+    try:
+        with open(filepath + READY_MARKER_SUFFIX) as f:
+            payload = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if int(payload.get("schema", -1)) > MARKER_SCHEMA_VERSION:
+        return None
+    if not payload.get("digest"):
+        return None
+    return payload
 
 
 def _read_archive(filepath: str):

@@ -54,6 +54,30 @@ four training pillars above):
   the logits boundary, proving the canary's finite-logits rejection (a
   NaN-producing checkpoint must never be promoted into live traffic).
 
+Control-plane faults (the continuous train→serve loop,
+``serve/resilience/promotion.py`` + ``tools/promotion_daemon.py``):
+
+* ``corrupt_candidate_at`` — truncate the promotion daemon's STAGED copy
+  of the next candidate checkpoint at byte N right before it verifies it
+  (bit-rot between trainer publish and daemon pickup), proving the
+  candidate-rejection journal path without touching the trainer's own
+  files;
+* ``kill_trainer_mid_publish`` — SIGKILL the trainer inside the torn
+  window of an epoch-checkpoint publish: after the archive (and alias)
+  landed but BEFORE the ``.ready`` done-marker, so a directory watcher
+  that honors the marker never sees the half-published epoch, and the
+  resumed trainer re-publishes it whole;
+* ``daemon_kill_at_phase`` — SIGKILL the promotion daemon at a named
+  phase boundary (``serve/resilience/promotion.py`` phase constants:
+  1 = journaled/pre-verify, 2 = verified/pre-publish, 3 = published/
+  pre-journal, 4 = promoted-journaled/pre-SLO-resolution), proving
+  crash-safe journal replay at every boundary;
+* ``regress_after_promote`` — arm ``nan_next_logits=K`` the moment the
+  NEXT promotion publishes (``promotion_applied`` hook in the pool/API
+  promote paths): the freshly promoted state immediately serves K
+  non-finite responses, the live-regression class that only a
+  POST-publish SLO watch can catch — the canary ran clean.
+
 Activation is programmatic (``activate(FaultPlan(...))`` from tests) or via
 the environment: ``MAML_FAULTS="nan_at_iter=40,sigterm_at_iter=120"``
 (comma/semicolon-separated ``key=int`` pairs), read once on first use so a
@@ -96,6 +120,10 @@ class FaultPlan:
     wedge_replica_at_request: int | None = None
     corrupt_swap_at: int | None = None
     nan_next_logits: int = 0
+    corrupt_candidate_at: int | None = None
+    kill_trainer_mid_publish: int = 0
+    daemon_kill_at_phase: int | None = None
+    regress_after_promote: int = 0
 
 
 _UNSET = object()  # env not yet consulted
@@ -351,6 +379,72 @@ def swap_checkpoint_loading(filepath: str) -> None:
     with open(filepath, "r+b") as f:
         f.truncate(n)
     events.append(f"corrupt-swap:{os.path.basename(filepath)}@{n}")
+
+
+# ---------------------------------------------------------------------------
+# Control-plane failure points (serve/resilience/promotion.py,
+# tools/promotion_daemon.py — the continuous train→serve loop)
+# ---------------------------------------------------------------------------
+
+
+def candidate_checkpoint_loading(filepath: str) -> None:
+    """Called by the promotion daemon right before it verifies a STAGED
+    candidate copy; applies the one-shot ``corrupt_candidate_at``
+    truncation. Staging isolates the fault: the trainer's own epoch file
+    is untouched, only the daemon's copy is corrupted — exactly the
+    bit-rot-between-publish-and-pickup class."""
+    plan = _active()
+    if plan is None or plan.corrupt_candidate_at is None:
+        return
+    n = plan.corrupt_candidate_at
+    plan.corrupt_candidate_at = None
+    with open(filepath, "r+b") as f:
+        f.truncate(n)
+    events.append(f"corrupt-candidate:{os.path.basename(filepath)}@{n}")
+
+
+def trainer_publish_marker(filepath: str) -> None:
+    """Called by ``utils/checkpoint.publish_done_marker`` right before the
+    ``.ready`` marker is written — the torn window between an epoch
+    archive landing and becoming watcher-visible. ``kill_trainer_mid_
+    publish`` SIGKILLs here (one-shot): the archive exists, the marker
+    never will (until the resumed run re-publishes the epoch), so a
+    marker-honoring watcher must not pick the checkpoint up."""
+    plan = _active()
+    if plan is None or plan.kill_trainer_mid_publish <= 0:
+        return
+    plan.kill_trainer_mid_publish = 0
+    events.append(f"kill-mid-publish:{os.path.basename(filepath)}")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def daemon_phase(phase: int) -> None:
+    """Called by the promotion daemon at each journal-phase boundary;
+    SIGKILLs the daemon process when ``daemon_kill_at_phase`` names this
+    phase (one-shot) — the crash-safe-journal-replay proof."""
+    plan = _active()
+    if plan is None or plan.daemon_kill_at_phase is None:
+        return
+    if int(plan.daemon_kill_at_phase) != int(phase):
+        return
+    plan.daemon_kill_at_phase = None
+    events.append(f"daemon-kill:phase{phase}")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def promotion_applied() -> None:
+    """Called by the pool/API promote paths the moment a promotion
+    PUBLISHES; converts an armed ``regress_after_promote=K`` into
+    ``nan_next_logits=K`` (one-shot) so the freshly promoted state
+    immediately regresses live traffic — the class the pre-publish canary
+    cannot catch and the post-promotion SLO watch exists for."""
+    plan = _active()
+    if plan is None or plan.regress_after_promote <= 0:
+        return
+    k = plan.regress_after_promote
+    plan.regress_after_promote = 0
+    plan.nan_next_logits = k
+    events.append(f"regress-after-promote:{k}")
 
 
 def poison_logits(logits: np.ndarray) -> np.ndarray:

@@ -97,6 +97,15 @@ def get_parser() -> argparse.ArgumentParser:
     # "process" (reference DataLoader-worker model: forked workers, linear
     # scaling past the GIL). TPU flag.
     add("--dataprovider_backend", type=str, default="thread")
+    # Hard-episode feedback loop (tools/episode_miner.py): a replay
+    # manifest of mined serving-episode seeds, mixed into the TRAIN
+    # stream every Nth episode slot (data/loader.py). TPU flags.
+    add("--replay_manifest", type=str, default="",
+        help="replay manifest JSON of mined hard-episode seeds to mix "
+        "into the training stream (empty: off)")
+    add("--replay_every", type=int, default=8,
+        help="every Nth train episode slot draws a mined replay seed "
+        "(only with --replay_manifest)")
     add("--max_pooling", type=str, default="False")
     add("--per_step_bn_statistics", type=str, default="False")
     add("--num_classes_per_set", type=int, default=20)

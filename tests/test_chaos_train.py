@@ -109,6 +109,38 @@ def test_chaos_schedule_of_six_fault_classes_recovers_unattended(workdir):
     assert verdict["ok"], verdict
 
 
+def test_promote_chaos_continuous_train_serve_loop(workdir):
+    """ISSUE 13 acceptance, end to end with zero intervention: a REAL
+    trainer run publishes checkpoints while a 2-replica pool serves
+    continuous loadtest traffic and the promotion-daemon CLI (its own
+    process) drives the loop. Through one run: the trainer SIGKILLed
+    mid-publish (torn window — the marker protocol keeps the watcher
+    blind) and resumed; the daemon's first staged candidate corrupted
+    (``corrupt_candidate_at``) and rejected pre-publish; the daemon
+    itself SIGKILLed after its first promoted row and restarted with no
+    outcome change (journal replay — no double-promote, no skipped
+    candidate); >= 3 clean automatic promotions; one forced
+    post-promotion regression (``regress_after_promote`` -> NaN logits
+    on live traffic) rolled back automatically to the prior digest; p99
+    verdict PASS with ZERO failed requests through every swap; and the
+    run's own telemetry mined into a non-empty replay manifest."""
+    from tools.chaos_train import run_promote_chaos
+
+    verdict = run_promote_chaos(workdir, verbose=False)
+    assert verdict["trainer_completed"], verdict
+    assert verdict.get("trainer_killed_mid_publish"), verdict
+    assert verdict.get("daemon_killed_mid_run"), verdict
+    assert verdict["promotions"] >= 3, verdict
+    assert verdict["corrupt_rejected"] >= 1, verdict
+    assert verdict["rollback_seen"] and verdict["rollback_to_lkg"], verdict
+    assert verdict["double_promoted"] == [], verdict
+    assert verdict["loadtest_offered"] > 0
+    assert verdict["loadtest_failed"] == 0, verdict
+    assert verdict["loadtest_slo_pass"], verdict
+    assert verdict["mined_episodes"] > 0, verdict
+    assert verdict["ok"], verdict
+
+
 def test_chaos_exact_path_schedule_is_bitexact_vs_unfaulted_twin(workdir):
     """Preemption + worker-kill + ENOSPC recoveries REPLAY the same
     trajectory: final params bit-exact vs an unfaulted twin run (the

@@ -153,6 +153,84 @@ def test_loader_batching_and_resume(dataset_env):
     np.testing.assert_array_equal(batches[2][4], resumed_batches[0][4])
 
 
+def test_loader_mixes_replay_manifest_into_train_stream(dataset_env, tmp_path):
+    """Hard-episode feedback edge (ISSUE 13): with a replay manifest
+    configured, every Nth TRAIN episode slot draws a mined seed (cycled,
+    deterministic — the yielded batch's seed column proves it), the other
+    slots are untouched, and val batches never replay."""
+    import json as json_module
+
+    manifest = tmp_path / "replay_manifest.json"
+    manifest.write_text(json_module.dumps({
+        "schema": 1, "source": "test",
+        "episodes": [{"seed": 777, "margin": 0.01},
+                     {"seed": 888, "margin": 0.02},
+                     {"seed": 999, "margin": 0.03}],
+    }))
+    plain_args = make_args(dataset_env)
+    plain = MetaLearningSystemDataLoader(plain_args, current_iter=0)
+    plain_batches = list(
+        plain.get_train_batches(total_batches=2, augment_images=False)
+    )
+
+    args = make_args(
+        dataset_env, replay_manifest=str(manifest), replay_every=4
+    )
+    loader = MetaLearningSystemDataLoader(args, current_iter=0)
+    assert loader.replay_seeds == (777, 888, 999)
+    batches = list(
+        loader.get_train_batches(total_batches=2, augment_images=False)
+    )
+    seeds = np.concatenate([b[4] for b in batches])
+    plain_seeds = np.concatenate([b[4] for b in plain_batches])
+    # Slots 3 and 7 (1-based every-4th) replay mined seeds, cycled.
+    assert seeds[3] == 777 and seeds[7] == 888
+    untouched = [i for i in range(len(seeds)) if (i + 1) % 4]
+    np.testing.assert_array_equal(seeds[untouched], plain_seeds[untouched])
+    # The replayed episode is the mined seed's episode, bit-exact.
+    ds = FewShotLearningDataset(make_args(dataset_env))
+    xs_777, *_ = ds.get_set("train", seed=777, augment_images=False)
+    np.testing.assert_array_equal(batches[0][0][3], xs_777)
+    # Val stream: no replay, identical to the plain loader's.
+    val = list(loader.get_val_batches(total_batches=1))
+    plain_val = list(plain.get_val_batches(total_batches=1))
+    np.testing.assert_array_equal(val[0][4], plain_val[0][4])
+    # Resume alignment: slots are keyed to the GLOBAL episode index, so a
+    # loader resumed mid-run reproduces the uninterrupted run's replay
+    # stream exactly — the pinned resume bit-exactness contract holds
+    # with a manifest active.
+    uninterrupted = MetaLearningSystemDataLoader(args, current_iter=0)
+    full = list(
+        uninterrupted.get_train_batches(total_batches=3, augment_images=False)
+    )
+    resumed = MetaLearningSystemDataLoader(args, current_iter=2)
+    resumed_batches = list(
+        resumed.get_train_batches(total_batches=1, augment_images=False)
+    )
+    # Global slot 11 rides cycle pointer 2 (seed 999) in BOTH runs — a
+    # within-call pointer would restart at 777 on resume.
+    assert resumed_batches[0][4][3] == 999 and full[2][4][3] == 999
+    np.testing.assert_array_equal(full[2][4], resumed_batches[0][4])
+    np.testing.assert_array_equal(full[2][0], resumed_batches[0][0])
+
+
+def test_loader_rejects_bad_replay_manifest(dataset_env, tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": 99, "episodes": [{"seed": 1}]}')
+    with pytest.raises(ValueError, match="newer"):
+        MetaLearningSystemDataLoader(
+            make_args(dataset_env, replay_manifest=str(bad)),
+            current_iter=0,
+        )
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"schema": 1, "episodes": []}')
+    with pytest.raises(ValueError, match="no episodes"):
+        MetaLearningSystemDataLoader(
+            make_args(dataset_env, replay_manifest=str(empty)),
+            current_iter=0,
+        )
+
+
 def test_loader_val_batches_repeatable(dataset_env):
     args = make_args(dataset_env)
     loader = MetaLearningSystemDataLoader(args, current_iter=0)

@@ -1,0 +1,926 @@
+"""Continuous train→serve control loop (ISSUE 13): promotion daemon
+idempotency, torn-publish visibility, SLO auto-rollback, and the
+hard-episode feedback edge.
+
+Everything here is deterministic and in-process: the daemon is driven
+against a stub fleet (promote/healthz/metrics_text) so journal replay,
+dedupe, val-gating, retry and rollback are provable without subprocess
+nondeterminism; daemon SIGKILLs are simulated by aborting the pipeline at
+the exact ``faultinject.daemon_phase`` boundaries and rebuilding the
+daemon over the same journal — the artifact state a real SIGKILL leaves.
+The real-process topology (trainer CLI + front door + daemon CLI killed
+with SIGKILL) is proven by the chaos harness
+(``tests/test_chaos_train.py::test_promote_chaos_*``)."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    MAMLFewShotLearner,
+)
+from howtotrainyourmamlpytorch_tpu.serve import ServeConfig, ServingAPI
+from howtotrainyourmamlpytorch_tpu.serve.engine import confidence_stats
+from howtotrainyourmamlpytorch_tpu.serve.pool import PoolConfig, ReplicaPool
+from howtotrainyourmamlpytorch_tpu.serve.resilience import LocalReplica
+from howtotrainyourmamlpytorch_tpu.serve.resilience import (
+    promotion as promo,
+)
+from howtotrainyourmamlpytorch_tpu.serve.resilience.promotion import (
+    PromotionConfig,
+    PromotionDaemon,
+    PromotionJournal,
+    replay_journal,
+)
+from howtotrainyourmamlpytorch_tpu.telemetry import EventLog
+from howtotrainyourmamlpytorch_tpu.telemetry import events as telemetry_events
+from howtotrainyourmamlpytorch_tpu.telemetry.events import read_events
+from howtotrainyourmamlpytorch_tpu.utils import faultinject
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter,
+    checkpoint_digest,
+    publish_alias,
+    publish_done_marker,
+    read_done_marker,
+    save_checkpoint,
+    snapshot_for_save,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.deactivate()
+    yield
+    faultinject.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# Fixture checkpoints + stub fleet
+# ---------------------------------------------------------------------------
+
+
+def state_tree(seed: int) -> dict:
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.rand(4, 3).astype(np.float32),
+        "b": rng.rand(3).astype(np.float32),
+    }
+
+
+def write_candidate(
+    watch_dir, epoch, seed=None, val_acc=0.5, with_stats=True, marker=True
+):
+    """One published epoch checkpoint (+ optional done-marker)."""
+    os.makedirs(watch_dir, exist_ok=True)
+    exp_state = {"current_iter": epoch * 2}
+    if with_stats:
+        exp_state["per_epoch_statistics"] = {
+            "val_accuracy_mean": [val_acc - 0.01, val_acc][: epoch + 1]
+            or [val_acc]
+        }
+        exp_state["best_val_acc"] = val_acc
+    path = os.path.join(watch_dir, f"train_model_{epoch}")
+    save_checkpoint(path, state_tree(seed if seed is not None else epoch),
+                    exp_state)
+    if marker:
+        publish_done_marker(path)
+    return path
+
+
+class StubTarget:
+    """A fleet front door reduced to what the daemon consumes."""
+
+    def __init__(self):
+        self.promoted: list[str] = []
+        self.digest: str | None = None
+        self.fail_promotes = 0
+        self.nonfinite_after_promotes: set[int] = set()
+        self._nonfinite_delay: int | None = None
+        self.counters = {"requests": 100.0, "errors": 0.0, "nonfinite": 0.0,
+                         "p99": 5.0}
+
+    def promote(self, path):
+        if self.fail_promotes > 0:
+            self.fail_promotes -= 1
+            raise ConnectionError("fleet transiently unreachable")
+        self.promoted.append(path)
+        self.digest = checkpoint_digest(path)
+        if len(self.promoted) in self.nonfinite_after_promotes:
+            # Live regression shape: the counter moves on traffic AFTER
+            # the publish (and after the daemon's baseline scrape).
+            self._nonfinite_delay = 1
+        return {"state_version": len(self.promoted)}
+
+    def healthz(self):
+        return {"ready": True, "last_promoted_digest": self.digest}
+
+    def metrics_text(self):
+        c = self.counters
+        if self._nonfinite_delay is not None:
+            if self._nonfinite_delay <= 0:
+                c["nonfinite"] += 3
+                self._nonfinite_delay = None
+            else:
+                self._nonfinite_delay -= 1
+        c["requests"] += 1  # live traffic keeps flowing
+        return (
+            f"maml_serve_pool_requests_total {c['requests']}\n"
+            f"maml_serve_pool_request_errors_total {c['errors']}\n"
+            f"maml_serve_pool_nonfinite_logits_total {c['nonfinite']}\n"
+            'maml_serve_pool_request_latency_ms{quantile="0.99"} '
+            f"{c['p99']}\n"
+        )
+
+
+def make_daemon(tmp_path, target, **overrides) -> PromotionDaemon:
+    defaults = dict(
+        watch_dir=str(tmp_path / "saved_models"),
+        journal_path=str(tmp_path / "logs" / "promotions.jsonl"),
+        staging_dir=str(tmp_path / "promotion_staging"),
+        poll_interval_s=0.05,
+        slo_watch_s=0.15,
+        slo_poll_s=0.03,
+        promote_retries=3,
+        promote_backoff_s=0.01,
+    )
+    defaults.update(overrides)
+    return PromotionDaemon(target, PromotionConfig(**defaults))
+
+
+def phases_for(journal_path, digest):
+    return [
+        row["phase"]
+        for row in PromotionJournal.load(journal_path)
+        if row.get("digest") == digest
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Torn-publish visibility (satellite: done-marker protocol)
+# ---------------------------------------------------------------------------
+
+
+def test_done_marker_digest_matches_file(tmp_path):
+    path = write_candidate(tmp_path / "saved_models", epoch=0)
+    marker = read_done_marker(path)
+    assert marker is not None
+    assert marker["digest"] == checkpoint_digest(path)
+    assert marker["bytes"] == os.path.getsize(path)
+
+
+def test_watcher_blind_until_marker_lands(tmp_path):
+    """An epoch archive without its ``.ready`` marker is invisible to the
+    candidate scan — the torn-publish window can never hand the daemon a
+    half-published checkpoint."""
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0, marker=False)
+    daemon = make_daemon(tmp_path, StubTarget())
+    assert daemon.scan_candidates() == []
+    publish_done_marker(os.path.join(str(watch), "train_model_0"))
+    assert [c.epoch for c in daemon.scan_candidates()] == [0]
+
+
+def test_marker_write_retries_transient_enospc(tmp_path):
+    """fail-next-K-writes regression (satellite): a transient write
+    failure during the marker publish is retried — the marker lands whole
+    and its digest still matches the archive."""
+    watch = tmp_path / "saved_models"
+    path = write_candidate(watch, epoch=0, marker=False)
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=2))
+    publish_done_marker(path)
+    assert any(e.startswith("write-fail:") for e in faultinject.events)
+    marker = read_done_marker(path)
+    assert marker is not None and marker["digest"] == checkpoint_digest(path)
+
+
+def test_marker_failure_past_budget_leaves_no_candidate(tmp_path):
+    """When every marker write attempt fails (budget exhausted), the
+    publish raises AND the watcher still sees nothing — fail closed."""
+    watch = tmp_path / "saved_models"
+    path = write_candidate(watch, epoch=0, marker=False)
+    faultinject.activate(faultinject.FaultPlan(fail_next_writes=10))
+    with pytest.raises(OSError):
+        publish_done_marker(path)
+    faultinject.deactivate()
+    daemon = make_daemon(tmp_path, StubTarget())
+    assert daemon.scan_candidates() == []
+
+
+def test_async_writer_publishes_marker_last(tmp_path):
+    """The async checkpoint writer's job order is archive → alias →
+    marker: when the marker exists the archive and alias are complete."""
+    tree = state_tree(0)
+    exp = {"per_epoch_statistics": {"val_accuracy_mean": [0.5]}}
+    epoch_path = str(tmp_path / "train_model_0")
+    latest = str(tmp_path / "train_model_latest")
+    writer = AsyncCheckpointWriter()
+    try:
+        writer.submit(
+            epoch_path, snapshot_for_save(tree, exp), alias_dst=latest,
+            publish_marker=True,
+        )
+        writer.drain()
+    finally:
+        writer.close()
+    marker = read_done_marker(epoch_path)
+    assert marker is not None
+    assert os.path.exists(latest)
+    assert marker["digest"] == checkpoint_digest(epoch_path)
+
+
+def test_kill_trainer_mid_publish_window_is_marker_shaped():
+    """The ``kill_trainer_mid_publish`` fault fires inside
+    ``publish_done_marker`` BEFORE the marker write — the archive is on
+    disk, the marker is not (hook-level pin; the SIGKILL itself is proven
+    by the chaos run)."""
+    plan = faultinject.activate(
+        faultinject.FaultPlan(kill_trainer_mid_publish=1)
+    )
+    fired = {}
+
+    def fake_kill(pid, sig):
+        fired["sig"] = sig
+
+    real_kill = os.kill
+    os.kill = fake_kill
+    try:
+        faultinject.trainer_publish_marker("/tmp/x")
+    finally:
+        os.kill = real_kill
+    assert fired and plan.kill_trainer_mid_publish == 0
+    assert faultinject.events == ["kill-mid-publish:x"]
+
+
+# ---------------------------------------------------------------------------
+# Daemon pipeline: promote, dedupe, gates
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_promotes_candidates_in_epoch_order(tmp_path):
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=1, val_acc=0.6)
+    write_candidate(watch, epoch=0, val_acc=0.5)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    daemon.run_once()
+    assert len(target.promoted) == 2
+    # Epoch order: the staged copy of epoch 0 was driven first.
+    assert "train_model_0" in target.promoted[0]
+    assert "train_model_1" in target.promoted[1]
+    journal = PromotionJournal.load(daemon.config.journal_path)
+    by_phase = [r["phase"] for r in journal]
+    assert by_phase.count("promoted") == 2
+    assert by_phase.count("slo_ok") == 2
+    # LKG is the newest clean publish; staged copies are retained there.
+    assert daemon._lkg is not None
+    assert os.path.exists(daemon._lkg["staged"])
+    assert daemon.resolved_promotions == 2
+
+
+def test_duplicate_digest_dedupes_without_repromote(tmp_path):
+    watch = tmp_path / "saved_models"
+    path0 = write_candidate(watch, epoch=0, val_acc=0.5)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    daemon.run_once()
+    assert len(target.promoted) == 1
+    # The same bytes resurface as a new epoch file (publish replay):
+    # deduped by content digest, journaled once, never re-promoted.
+    dup = os.path.join(str(watch), "train_model_7")
+    publish_alias(path0, dup)
+    publish_done_marker(dup)
+    daemon.run_once()
+    daemon.run_once()
+    assert len(target.promoted) == 1
+    rows = PromotionJournal.load(daemon.config.journal_path)
+    dedupes = [r for r in rows if r["phase"] == "deduped"]
+    assert len(dedupes) == 1 and "train_model_7" in dedupes[0]["path"]
+
+
+def test_val_gate_rejects_statless_and_regressing_candidates(tmp_path):
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0, with_stats=False)  # no val stat yet
+    write_candidate(watch, epoch=1, val_acc=0.7)
+    write_candidate(watch, epoch=2, val_acc=0.4)  # worse than LKG
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target, val_min_delta=0.0)
+    daemon.run_once()
+    assert len(target.promoted) == 1  # only epoch 1
+    rows = PromotionJournal.load(daemon.config.journal_path)
+    rejected = {
+        r["digest"]: r for r in rows if r["phase"] == "rejected"
+    }
+    reasons = sorted(r["reason"] for r in rejected.values())
+    assert reasons == ["val_gate", "val_gate"]
+    assert daemon.resolved_promotions == 1
+
+
+def test_corrupt_candidate_rejected_pre_publish_trainer_file_intact(
+    tmp_path,
+):
+    """``corrupt_candidate_at`` truncates the daemon's STAGED copy: the
+    candidate is rejected before any replica is touched, journaled and
+    emitted as a typed telemetry event, and the trainer's own epoch file
+    is untouched."""
+    watch = tmp_path / "saved_models"
+    path = write_candidate(watch, epoch=0, val_acc=0.5)
+    original_digest = checkpoint_digest(path)
+    sink = EventLog(str(tmp_path / "telemetry.jsonl"))
+    previous = telemetry_events.install(sink)
+    faultinject.activate(faultinject.FaultPlan(corrupt_candidate_at=64))
+    try:
+        target = StubTarget()
+        daemon = make_daemon(tmp_path, target)
+        daemon.run_once()
+    finally:
+        telemetry_events.install(previous)
+    assert target.promoted == []
+    assert any(
+        e.startswith("corrupt-candidate:") for e in faultinject.events
+    )
+    rows = PromotionJournal.load(daemon.config.journal_path)
+    rejected = [r for r in rows if r["phase"] == "rejected"]
+    assert len(rejected) == 1
+    assert rejected[0]["reason"] in ("digest_mismatch", "corrupt")
+    # Trainer's file untouched; only the staged copy was corrupted.
+    assert checkpoint_digest(path) == original_digest
+    sink.flush()
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    assert any(e["type"] == "promotion_rejected" for e in events)
+
+
+def test_transient_fleet_failure_retries_then_promotes(tmp_path):
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    target.fail_promotes = 2  # two transient failures, then healthy
+    daemon = make_daemon(tmp_path, target)
+    daemon.run_once()
+    assert len(target.promoted) == 1
+    assert phases_for(daemon.config.journal_path,
+                      target.digest)[-1] == "slo_ok"
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe idempotency: journal replay at every kill boundary
+# ---------------------------------------------------------------------------
+
+
+class _Killed(BaseException):
+    """In-process stand-in for SIGKILL: aborts the pipeline mid-phase;
+    the daemon object is then discarded and a fresh one replays the
+    journal — the exact artifact state a real SIGKILL leaves (the real
+    signal path is proven by the chaos run's daemon subprocess)."""
+
+
+def _kill_at_phase(monkeypatch, phase):
+    def hook(p):
+        if p == phase:
+            raise _Killed(f"phase {p}")
+
+    monkeypatch.setattr(promo.faultinject, "daemon_phase", hook)
+
+
+@pytest.mark.parametrize(
+    "kill_phase,promotes_before,expect_resume_without_promote",
+    [
+        (promo.KILL_PRE_VERIFY, 0, False),    # journaled, not verified
+        (promo.KILL_PRE_PUBLISH, 0, False),   # verified, fleet untouched
+        (promo.KILL_POST_PUBLISH, 1, True),   # published, row missing
+        (promo.KILL_PRE_RESOLVE, 1, True),    # promoted row, unresolved
+    ],
+)
+def test_journal_replay_after_kill_at_phase_boundary(
+    tmp_path, monkeypatch, kill_phase, promotes_before,
+    expect_resume_without_promote,
+):
+    """SIGKILL at each phase boundary, restart, resume idempotently:
+    exactly ONE fleet publish total — never a double-promote, never a
+    skipped candidate."""
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    _kill_at_phase(monkeypatch, kill_phase)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+    assert len(target.promoted) == promotes_before
+    monkeypatch.setattr(promo.faultinject, "daemon_phase", lambda p: None)
+
+    # Restart: a fresh daemon over the same journal + the same fleet.
+    daemon2 = make_daemon(tmp_path, target)
+    daemon2.run_once()
+    assert len(target.promoted) == 1, "exactly one publish, ever"
+    digest = checkpoint_digest(target.promoted[0])
+    phases = phases_for(daemon2.config.journal_path, digest)
+    assert phases[-1] == "slo_ok"
+    assert phases.count("promoted") >= 1
+    if expect_resume_without_promote:
+        promoted_rows = [
+            r for r in PromotionJournal.load(daemon2.config.journal_path)
+            if r["phase"] == "promoted"
+        ]
+        # The restart recorded the already-landed publish as resumed
+        # instead of double-driving it.
+        assert any(r.get("resumed") for r in promoted_rows) or (
+            kill_phase == promo.KILL_PRE_RESOLVE
+        )
+    # Idempotent forever after: more passes change nothing.
+    daemon2.run_once()
+    assert len(target.promoted) == 1
+    assert daemon2.resolved_promotions == 1
+
+
+def test_replay_ignores_resumed_rows_for_phase(tmp_path):
+    """A ``resumed`` audit row must not become a digest's last phase: a
+    second crash right after a resume would otherwise replay the
+    candidate from scratch and double-drive a landed publish."""
+    rows = [
+        {"t": 1.0, "phase": "start", "digest": "d1", "path": "p",
+         "staged": "s", "epoch": 0},
+        {"t": 2.0, "phase": "verified", "digest": "d1", "val_stat": 0.5},
+        {"t": 3.0, "phase": "resumed", "digest": "d1",
+         "from_phase": "verified"},
+    ]
+    state = replay_journal(rows)
+    assert state["inflight"]["last_phase"] == "verified"
+
+
+def test_double_crash_after_resume_still_single_promote(tmp_path, monkeypatch):
+    """Kill post-publish, resume, kill again mid-resume (after the
+    ``resumed`` row), restart: still exactly ONE fleet publish."""
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    _kill_at_phase(monkeypatch, promo.KILL_POST_PUBLISH)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+    assert len(target.promoted) == 1
+    # Second incarnation dies right after journaling its ``resumed`` row
+    # (before any further phase row lands).
+    daemon2 = make_daemon(tmp_path, target)
+    real_append = daemon2.journal.append
+
+    def append_then_die(phase, **fields):
+        row = real_append(phase, **fields)
+        if phase == promo.PHASE_RESUMED:
+            raise _Killed("mid-resume")
+        return row
+
+    monkeypatch.setattr(promo.faultinject, "daemon_phase", lambda p: None)
+    monkeypatch.setattr(daemon2.journal, "append", append_then_die)
+    with pytest.raises(_Killed):
+        daemon2.run_once()
+    # Third incarnation must resume from ``verified`` (fleet digest
+    # matches) — never reprocess from scratch.
+    daemon3 = make_daemon(tmp_path, target)
+    daemon3.run_once()
+    assert len(target.promoted) == 1, "double-promote after double crash"
+    digest = checkpoint_digest(target.promoted[0])
+    assert phases_for(daemon3.config.journal_path, digest)[-1] == "slo_ok"
+
+
+def test_unscrapeable_slo_window_leaves_candidate_unresolved(tmp_path):
+    """If /metrics is unscrapeable for the whole post-publish window, the
+    daemon must NOT bless the candidate ``slo_ok`` blind — it stays
+    journaled ``promoted`` and a later pass (metrics back) resolves it."""
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    real_metrics = target.metrics_text
+    target.metrics_text = lambda: (_ for _ in ()).throw(
+        ConnectionError("front door saturated")
+    )
+    daemon = make_daemon(tmp_path, target)
+    daemon.run_once()
+    assert len(target.promoted) == 1
+    digest = checkpoint_digest(target.promoted[0])
+    assert phases_for(daemon.config.journal_path, digest)[-1] == "promoted"
+    assert daemon.resolved_promotions == 0
+    # Metrics recover: the next pass re-judges a full window and resolves.
+    target.metrics_text = real_metrics
+    daemon.run_once()
+    assert phases_for(daemon.config.journal_path, digest)[-1] == "slo_ok"
+    assert len(target.promoted) == 1
+
+
+def test_resume_waits_when_fleet_unreachable(tmp_path, monkeypatch):
+    """Resume at the ``verified`` boundary with the fleet UNREACHABLE
+    must not decide: deciding blind risks double-driving a publish that
+    already landed. The candidate stays in-flight until /healthz answers."""
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    _kill_at_phase(monkeypatch, promo.KILL_POST_PUBLISH)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+    assert len(target.promoted) == 1
+    monkeypatch.setattr(promo.faultinject, "daemon_phase", lambda p: None)
+
+    daemon2 = make_daemon(tmp_path, target)
+    real_healthz = target.healthz
+    target.healthz = lambda: (_ for _ in ()).throw(ConnectionError("down"))
+    daemon2.run_once()
+    # Unreachable: neither a second publish nor a promoted row.
+    assert len(target.promoted) == 1
+    digest = checkpoint_digest(target.promoted[0])
+    assert "promoted" not in phases_for(
+        daemon2.config.journal_path, digest
+    )[2:]  # only the pre-crash publish... no resumed promoted row yet
+    # Fleet back: the same daemon resolves without double-driving.
+    target.healthz = real_healthz
+    daemon2.run_once()
+    assert len(target.promoted) == 1
+    assert phases_for(daemon2.config.journal_path, digest)[-1] == "slo_ok"
+
+
+def test_regression_without_lkg_is_loud_not_phantom(tmp_path):
+    """A first-ever promotion that regresses has nothing to roll back to:
+    the journal row records ``no_lkg`` and a distinct
+    ``slo_rollback_unavailable`` event fires — never a phantom
+    "rolled back" claim."""
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    target.nonfinite_after_promotes = {1}  # the very first publish regresses
+    sink = EventLog(str(tmp_path / "telemetry.jsonl"))
+    previous = telemetry_events.install(sink)
+    try:
+        daemon = make_daemon(tmp_path, target)
+        daemon.run_once()
+    finally:
+        telemetry_events.install(previous)
+    assert len(target.promoted) == 1  # no rollback promote was driven
+    rows = PromotionJournal.load(daemon.config.journal_path)
+    rolled = [r for r in rows if r["phase"] == "rolled_back"]
+    assert rolled and rolled[0]["no_lkg"] is True and rolled[0]["to"] is None
+    sink.flush()
+    kinds = {e["type"] for e in read_events(str(tmp_path / "telemetry.jsonl"))}
+    assert "slo_rollback_unavailable" in kinds
+    assert "slo_rollback" not in kinds
+
+
+def test_replay_tolerates_torn_final_line(tmp_path):
+    journal = tmp_path / "promotions.jsonl"
+    journal.write_text(
+        json.dumps({"t": 1.0, "phase": "start", "digest": "d1",
+                    "path": "p", "staged": "s", "epoch": 0}) + "\n"
+        + '{"t": 2.0, "phase": "promo'  # torn mid-append by SIGKILL
+    )
+    state = replay_journal(PromotionJournal.load(str(journal)))
+    assert state["inflight"]["digest"] == "d1"
+    assert state["inflight"]["last_phase"] == "start"
+
+
+# ---------------------------------------------------------------------------
+# Post-promotion SLO watch + automatic rollback
+# ---------------------------------------------------------------------------
+
+
+def test_slo_regression_rolls_back_to_retained_lkg(tmp_path):
+    """A promotion whose state regresses live traffic (nonfinite counter
+    moves inside the watch window) is rolled back automatically to the
+    RETAINED last-known-good staged copy — even though the trainer's own
+    copy of that epoch could already be pruned."""
+    watch = tmp_path / "saved_models"
+    good = write_candidate(watch, epoch=0, val_acc=0.5)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    daemon.run_once()
+    assert len(target.promoted) == 1
+    lkg_staged = daemon._lkg["staged"]
+    good_digest = checkpoint_digest(good)
+
+    # The trainer prunes the source epoch; the daemon's retention copy
+    # is what rollback will drive.
+    os.remove(good)
+    os.remove(good + ".ready")
+
+    write_candidate(watch, epoch=1, val_acc=0.9, seed=11)
+    target.nonfinite_after_promotes = {2}  # regress right after publish
+    sink = EventLog(str(tmp_path / "telemetry.jsonl"))
+    previous = telemetry_events.install(sink)
+    try:
+        daemon.run_once()
+    finally:
+        telemetry_events.install(previous)
+    # Publish #2 was the bad candidate, publish #3 the rollback.
+    assert len(target.promoted) == 3
+    assert target.promoted[2] == lkg_staged
+    assert target.digest == good_digest
+    rows = PromotionJournal.load(daemon.config.journal_path)
+    bad_digest = [
+        r["digest"] for r in rows if r["phase"] == "rollback_start"
+    ][0]
+    assert phases_for(daemon.config.journal_path, bad_digest)[-1] == (
+        "rolled_back"
+    )
+    rolled = [r for r in rows if r["phase"] == "rolled_back"][0]
+    assert rolled["to"] == good_digest
+    # LKG unchanged: the regressing digest never becomes a rollback
+    # target, and the typed telemetry trail names the reason.
+    assert daemon._lkg["digest"] == good_digest
+    sink.flush()
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    kinds = {e["type"] for e in events}
+    assert {"slo_regression", "slo_rollback"} <= kinds
+
+
+def test_regress_after_promote_fault_arms_nan_logits():
+    plan = faultinject.activate(
+        faultinject.FaultPlan(regress_after_promote=4)
+    )
+    faultinject.promotion_applied()
+    assert plan.nan_next_logits == 4
+    assert plan.regress_after_promote == 0
+    faultinject.promotion_applied()  # one-shot
+    assert plan.nan_next_logits == 4
+
+
+def test_slo_watch_thresholds():
+    cfg = PromotionConfig(
+        watch_dir=".", journal_path="j", staging_dir=".",
+        max_error_rate=0.1, max_new_nonfinite=0, min_requests=10,
+        p99_budget_ms=100.0,
+    )
+    target = StubTarget()
+    watch = promo.SloWatch(target, cfg)
+    base = watch.sample_now()
+    assert watch.verdict(base) is None
+    target.counters["nonfinite"] += 1
+    watch.sample_now()
+    assert "nonfinite" in watch.verdict(base)
+    # Error-rate needs min_requests answered first.
+    target.counters["nonfinite"] -= 1
+    target.counters["errors"] += 3
+    watch.sample_now()
+    assert watch.verdict(base) is None  # only a handful of requests yet
+    target.counters["requests"] += 20
+    watch.sample_now()
+    assert "error rate" in watch.verdict(base)
+
+
+# ---------------------------------------------------------------------------
+# Serving confidence telemetry + nonfinite counters (satellite)
+# ---------------------------------------------------------------------------
+
+
+def tiny_api(**kw):
+    cfg = MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2, num_filters=4, image_height=8, image_width=8,
+            num_classes=5, per_step_bn_statistics=True, num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+    )
+    learner = MAMLFewShotLearner(cfg)
+    defaults = dict(meta_batch_size=2, max_wait_ms=0.0)
+    defaults.update(kw)
+    return ServingAPI(
+        learner, learner.init_state(jax.random.key(0)),
+        ServeConfig(**defaults),
+    )
+
+
+def episode(rng, way=5, shot=1, query=3):
+    img = (1, 8, 8)
+    xs = rng.rand(way * shot, *img).astype(np.float32)
+    ys = np.repeat(np.arange(way), shot).astype(np.int32)
+    xq = rng.rand(query, *img).astype(np.float32)
+    return xs, ys, xq
+
+
+def test_confidence_stats_shape_and_degradation():
+    logits = np.array([[10.0, 0.0, 0.0], [0.0, 5.0, 4.9]])
+    margin, entropy = confidence_stats(logits)
+    assert 0.0 < margin < 1.0 and entropy > 0.0
+    sure = confidence_stats(np.array([[100.0, 0.0, 0.0]]))
+    unsure = confidence_stats(np.array([[0.1, 0.0, 0.0]]))
+    assert sure[0] > unsure[0] and sure[1] < unsure[1]
+    nan_margin, _ = confidence_stats(np.full((2, 3), np.nan))
+    assert not np.isfinite(nan_margin)
+
+
+def test_serve_dispatch_stamps_margin_entropy_tags(rng, tmp_path):
+    api = tiny_api()
+    sink = EventLog(str(tmp_path / "telemetry.jsonl"))
+    previous = telemetry_events.install(sink)
+    try:
+        api.classify(*episode(rng), tag="seed:41")
+        api.classify(*episode(rng))
+    finally:
+        telemetry_events.install(previous)
+        api.close()
+    sink.flush()
+    dispatches = [
+        e for e in read_events(str(tmp_path / "telemetry.jsonl"))
+        if e["type"] == "serve_dispatch"
+    ]
+    assert dispatches
+    tags = [t for e in dispatches for t in e["tags"]]
+    assert "seed:41" in tags
+    for e in dispatches:
+        assert len(e["margins"]) == e["episodes"]
+        assert len(e["entropies"]) == e["episodes"]
+        assert all(
+            m is None or 0.0 <= m <= 1.0 for m in e["margins"]
+        )
+        assert e["nonfinite"] == 0
+
+
+def test_confidence_stamping_is_host_side(rng, compile_guard):
+    """Margin/entropy stamping adds zero program signatures and zero
+    device syncs: pure numpy over the already-fetched host logits."""
+    api = tiny_api()
+    try:
+        api.classify(*episode(rng))  # warm the program pair
+        with compile_guard() as guard:
+            for i in range(3):
+                api.classify(*episode(rng, query=3), tag=f"seed:{i}")
+        guard.assert_compiles("serve_adapt_maml", exactly=0)
+        guard.assert_compiles("serve_classify_maml", exactly=0)
+    finally:
+        api.close()
+
+
+def test_nonfinite_logits_counted_engine_and_pool(rng):
+    """NaN logits on live traffic move the nonfinite counters at BOTH
+    surfaces the SLO watch can scrape: the engine's own /metrics and the
+    pool front door's."""
+    def factory(index):
+        api = tiny_api()
+        api.engine.warmup([(5, 1, 3)])
+        return LocalReplica(api, replica_id=f"local-{index}")
+
+    pool = ReplicaPool(
+        factory,
+        PoolConfig(n_replicas=1, health_interval_s=0.02,
+                   restart_backoff_s=0.05, min_uptime_s=0.0),
+    )
+    try:
+        assert pool.wait_ready(timeout=120.0)
+        pool.classify(*episode(rng))
+        assert pool.metrics.nonfinite_logits_total.value == 0
+        faultinject.activate(faultinject.FaultPlan(nan_next_logits=1))
+        pool.classify(*episode(rng))
+        assert pool.metrics.nonfinite_logits_total.value == 1
+        assert "maml_serve_pool_nonfinite_logits_total 1" in (
+            pool.metrics_text()
+        )
+    finally:
+        pool.close()
+
+
+def test_single_api_metrics_expose_nonfinite_and_digest(rng, tmp_path):
+    api = tiny_api()
+    try:
+        faultinject.activate(faultinject.FaultPlan(nan_next_logits=1))
+        api.classify(*episode(rng))
+        assert api.metrics.nonfinite_logits_total.value >= 1
+        assert "maml_serve_nonfinite_logits_total" in api.metrics_text()
+        assert api.healthz()["checkpoint_digest"] is None  # boot state
+    finally:
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# Hard-episode feedback edge: miner -> replay manifest -> loader mix-in
+# ---------------------------------------------------------------------------
+
+
+def test_miner_selects_low_margin_tagged_episodes(tmp_path):
+    from tools.episode_miner import (
+        mine_events,
+        select_hard_episodes,
+        write_manifest,
+    )
+
+    events = [
+        {"type": "serve_dispatch", "tags": ["seed:5", "seed:6"],
+         "margins": [0.05, 0.9], "entropies": [1.5, 0.1]},
+        {"type": "serve_dispatch", "tags": ["seed:5", None],
+         "margins": [0.2, 0.01], "entropies": [1.0, 2.0]},
+        {"type": "serve_dispatch", "tags": ["untagged"],
+         "margins": [0.0], "entropies": [2.0]},
+        {"type": "serve_dispatch", "tags": ["seed:7"],
+         "margins": [None], "entropies": [None]},  # NaN logits episode
+        {"type": "step"},
+    ]
+    stats = mine_events(events)
+    assert set(stats) == {5, 6, 7}
+    assert stats[5]["count"] == 2 and stats[5]["margin"] == 0.05
+    assert stats[7]["margin"] == 0.0  # non-finite = maximally hard
+    hard = select_hard_episodes(stats, max_margin=0.5, top=10)
+    assert [row["seed"] for row in hard] == [7, 5]  # hardest first
+
+    out = str(tmp_path / "replay_manifest.json")
+    write_manifest(out, hard, source="test")
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        load_replay_manifest,
+    )
+
+    assert load_replay_manifest(out) == (7, 5)
+
+
+def test_replay_seed_mixes_deterministically():
+    from howtotrainyourmamlpytorch_tpu.data.loader import replay_seed
+
+    seeds = (101, 202)
+    stream = [replay_seed(1000, i, seeds, 4) for i in range(12)]
+    # Every 4th slot draws a mined seed, cycled; the rest are untouched.
+    assert stream[3] == 101 and stream[7] == 202 and stream[11] == 101
+    untouched = [s for i, s in enumerate(stream) if (i + 1) % 4]
+    assert untouched == [1000 + i for i in range(12) if (i + 1) % 4]
+    # Off = identity.
+    assert [replay_seed(1000, i, (), 0) for i in range(4)] == [
+        1000, 1001, 1002, 1003
+    ]
+
+
+def test_miner_cli_refuses_empty_manifest(tmp_path):
+    """Nothing mined -> no manifest written, non-zero exit — a scripted
+    mine-then-train pipeline must branch instead of handing the loader an
+    empty manifest it refuses."""
+    import subprocess
+    import sys
+
+    telemetry = tmp_path / "telemetry.jsonl"
+    telemetry.write_text(json.dumps({
+        "t": 1.0, "type": "serve_dispatch", "tags": ["seed:9"],
+        "margins": [0.9], "entropies": [0.1],
+    }) + "\n")
+    out = tmp_path / "manifest.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "episode_miner.py"),
+         "--telemetry", str(telemetry), "--out", str(out),
+         "--max-margin", "0.1", "--json"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert not out.exists()
+    assert json.loads(proc.stdout)["mined"] == 0
+
+
+def test_miner_cli_round_trip(tmp_path):
+    import subprocess
+    import sys
+
+    telemetry = tmp_path / "telemetry.jsonl"
+    with open(telemetry, "w") as f:
+        f.write(json.dumps({
+            "t": 1.0, "type": "serve_dispatch", "tags": ["seed:9"],
+            "margins": [0.1], "entropies": [1.0],
+        }) + "\n")
+    out = tmp_path / "manifest.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "episode_miner.py"),
+         "--telemetry", str(telemetry), "--out", str(out), "--json"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["mined"] == 1
+    manifest = json.loads(out.read_text())
+    assert manifest["episodes"][0]["seed"] == 9
+
+
+# ---------------------------------------------------------------------------
+# Daemon threads shut down clean (thread-lifecycle contract, live)
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_threads_start_and_join(tmp_path):
+    watch = tmp_path / "saved_models"
+    write_candidate(watch, epoch=0)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target)
+    daemon.start()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and not target.promoted:
+        time.sleep(0.02)
+    daemon.close()
+    assert target.promoted, "watcher thread never drove the promotion"
+    assert not daemon._thread.is_alive()
+    assert not daemon.slo._thread.is_alive()
+    leftovers = [
+        t for t in threading.enumerate()
+        if t.name in ("promotion-watcher", "promotion-slo-sampler")
+        and t.is_alive()
+    ]
+    assert leftovers == []

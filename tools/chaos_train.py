@@ -660,6 +660,388 @@ def run_killhost_chaos(workdir: str, verbose: bool = True) -> dict:
     return verdict
 
 
+#: Wall budget for the promote chaos run (trainer to completion + the
+#: daemon resolving every candidate + the forced rollback).
+PROMOTE_TIMEOUT_S = 600
+
+PROMOTION_DAEMON = os.path.join("tools", "promotion_daemon.py")
+
+
+def _daemon_argv(exp_dir: str, url: str) -> list[str]:
+    return [
+        sys.executable, "-u", os.path.join(REPO, PROMOTION_DAEMON),
+        "--watch", os.path.join(exp_dir, "saved_models"),
+        "--target", url,
+        "--journal", os.path.join(exp_dir, "logs", "promotions.jsonl"),
+        "--staging", os.path.join(exp_dir, "promotion_staging"),
+        "--telemetry", os.path.join(exp_dir, "logs", "telemetry.jsonl"),
+        "--poll_interval_s", "0.3",
+        "--slo_watch_s", "2.0", "--slo_poll_s", "0.2",
+        "--min_requests", "1",
+        "--promote_retries", "4", "--promote_backoff_s", "0.3",
+    ]
+
+
+def _read_journal(exp_dir: str) -> list[dict]:
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.promotion import (
+        PromotionJournal,
+    )
+
+    return PromotionJournal.load(
+        os.path.join(exp_dir, "logs", "promotions.jsonl")
+    )
+
+
+def run_promote_chaos(
+    workdir: str,
+    verbose: bool = True,
+    kill_trainer: bool = True,
+    epochs: int = 5,
+) -> dict:
+    """The continuous train→serve loop, end to end, zero intervention:
+
+    a REAL ``train_maml_system.py`` run publishes epoch checkpoints
+    (async writer + ``.ready`` markers) while a 2-replica pool serves
+    continuous loadtest traffic and the promotion-daemon CLI (its own
+    process) watches the checkpoint dir and drives canary-first
+    promotions through the pool's HTTP front door. Faults, each mapping
+    to its documented recovery:
+
+    * ``kill_trainer_mid_publish`` — the trainer is SIGKILLed inside the
+      torn window (epoch archive on disk, marker not): the watcher never
+      sees the half-published epoch, the resumed run re-publishes from
+      ``latest`` and the loop continues;
+    * ``corrupt_candidate_at`` (daemon env) — the daemon's first staged
+      candidate is truncated: rejected pre-publish, journaled + typed
+      telemetry, trainer files untouched;
+    * harness SIGKILL of the daemon after its first ``promoted`` row —
+      the restarted daemon replays the journal and resumes idempotently
+      (no double-promote, no skipped candidate);
+    * ``regress_after_promote`` — armed before the LAST candidate's
+      publish: the freshly promoted state serves NaN logits, the
+      post-publish SLO watch sees the nonfinite counter move and rolls
+      the fleet back to the retained last-known-good digest.
+
+    Asserted outcome: >= 3 clean automatic promotions, the corrupt
+    rejection, the rollback, loadtest SLO PASS with ZERO failed requests
+    through every swap, and the miner turning the run's own telemetry
+    into a non-empty replay manifest."""
+    import threading as _threading
+
+    from howtotrainyourmamlpytorch_tpu.serve import make_http_server
+    from howtotrainyourmamlpytorch_tpu.serve.pool import (
+        PoolConfig,
+        ReplicaPool,
+    )
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.replica import (
+        LocalReplica,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry import events as tel_events
+    from howtotrainyourmamlpytorch_tpu.telemetry.events import EventLog
+    from howtotrainyourmamlpytorch_tpu.utils import faultinject
+    from tools.serve_loadtest import run_loadtest, synth_episodes
+
+    def log(msg):
+        if verbose:
+            print(f"chaos: {msg}", file=sys.stderr, flush=True)
+
+    cfg_path = tiny_config(workdir, "chaos_promote", devices=1)
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    cfg["total_epochs"] = int(epochs)
+    cfg["total_iter_per_epoch"] = 1
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    exp_dir = cfg["experiment_name"]
+    os.makedirs(os.path.join(exp_dir, "logs"), exist_ok=True)
+    test_csv = os.path.join(exp_dir, "logs", "test_summary.csv")
+    telemetry_path = os.path.join(exp_dir, "logs", "telemetry.jsonl")
+
+    # -- serving fleet (in-process 2-replica pool + HTTP front door) ----
+    previous_dataset_dir = os.environ.get("DATASET_DIR")
+    os.environ["DATASET_DIR"] = workdir
+    sink = EventLog(telemetry_path)
+    previous_sink = tel_events.install(sink)
+    from tools.serve_maml import build_learner
+
+    learner = build_learner("maml", cfg_path)
+    way = int(cfg["num_classes_per_set"])
+    query = int(cfg["num_target_samples"])
+
+    def factory(index: int) -> LocalReplica:
+        import jax
+
+        from howtotrainyourmamlpytorch_tpu.serve import (
+            ServeConfig,
+            ServingAPI,
+        )
+
+        api = ServingAPI(
+            learner, learner.init_state(jax.random.PRNGKey(0)),
+            ServeConfig(meta_batch_size=2, max_wait_ms=0.0),
+        )
+        api.engine.warmup([(way, 1, query)])
+        return LocalReplica(api, replica_id=f"local-{index}")
+
+    pool = ReplicaPool(
+        factory,
+        PoolConfig(
+            n_replicas=2, health_interval_s=0.1, restart_backoff_s=0.2,
+            min_uptime_s=0.0,
+        ),
+    )
+    daemon_proc: dict | None = None
+    server = None
+    stop_traffic = _threading.Event()
+    loadtest_results: list[dict] = []
+    verdict: dict = {"schedule": ["promote"], "ok": False}
+    try:
+        if not pool.wait_ready(timeout=300.0):
+            raise RuntimeError("2-replica pool never became healthy")
+        server = make_http_server(pool, "127.0.0.1", 0)
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}"
+        server_thread = _threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        server_thread.start()
+        log(f"pool front door on {url}")
+
+        # -- continuous loadtest traffic (in-process, tagged) -----------
+        bb = learner.cfg.backbone
+        image_shape = (bb.image_channels, bb.image_height, bb.image_width)
+        episodes = synth_episodes(
+            16, way=way, shot=1, query=query, image_shape=image_shape,
+            seed=3,
+        )
+
+        def offer_traffic():
+            while not stop_traffic.is_set():
+                loadtest_results.append(run_loadtest(
+                    pool, episodes, rate_qps=4.0, duration_s=5.0,
+                    p99_budget_ms=5_000.0, error_slo=0.0, timeout_s=10.0,
+                    seed=len(loadtest_results), sample_health=False,
+                    tag_seed_base=50_000,
+                ))
+
+        traffic_thread = _threading.Thread(target=offer_traffic, daemon=True)
+        traffic_thread.start()
+
+        # -- promotion daemon (own process; corrupt-candidate armed) ----
+        daemon_env = dict(os.environ)
+        daemon_env["PYTHONPATH"] = REPO + os.pathsep + daemon_env.get(
+            "PYTHONPATH", ""
+        )
+        daemon_env["JAX_PLATFORMS"] = "cpu"
+        daemon_env["MAML_FAULTS"] = "corrupt_candidate_at=600"
+        daemon_proc = daemon_holder = {"proc": subprocess.Popen(
+            _daemon_argv(exp_dir, url), cwd=REPO, env=daemon_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )}
+        log("promotion daemon started (corrupt_candidate_at=600)")
+        t_deadline = time.time() + PROMOTE_TIMEOUT_S
+
+        # -- mid-run daemon SIGKILL + restart (concurrent killer) -------
+        def kill_and_restart_daemon():
+            while time.time() < t_deadline and not stop_traffic.is_set():
+                rows = _read_journal(exp_dir)
+                if any(r["phase"] == "promoted" for r in rows):
+                    log("SIGKILL the daemon mid-run (first promoted row)")
+                    daemon_holder["proc"].kill()
+                    daemon_holder["proc"].wait(timeout=30)
+                    restart_env = dict(daemon_env)
+                    restart_env.pop("MAML_FAULTS", None)
+                    daemon_holder["proc"] = subprocess.Popen(
+                        _daemon_argv(exp_dir, url), cwd=REPO,
+                        env=restart_env,
+                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    )
+                    verdict["daemon_killed_mid_run"] = True
+                    return
+                time.sleep(0.3)
+
+        killer_thread = _threading.Thread(
+            target=kill_and_restart_daemon, daemon=True
+        )
+        killer_thread.start()
+
+        # -- the real trainer, SIGKILLed mid-publish then resumed -------
+        trainer_faults = (
+            {"kill_trainer_mid_publish": 1} if kill_trainer else None
+        )
+        trainer_runs = 0
+        while not os.path.exists(test_csv) and trainer_runs < 4:
+            trainer_runs += 1
+            log(f"trainer run {trainer_runs} "
+                f"(faults={trainer_faults or 'none'})")
+            proc = subprocess.run(
+                [sys.executable, "-u", ENTRY, "--name_of_args_json_file",
+                 cfg_path],
+                cwd=REPO, env=_child_env(workdir, 1, trainer_faults),
+                capture_output=True, text=True, timeout=PHASE_TIMEOUT_S,
+                check=False,
+            )
+            if trainer_faults and proc.returncode in (-9, 137):
+                verdict["trainer_killed_mid_publish"] = True
+            trainer_faults = None
+        verdict["trainer_completed"] = os.path.exists(test_csv)
+
+        # -- wait for every trainer candidate to resolve ----------------
+        expected_clean = int(epochs) - (3 if kill_trainer else 2)
+        while time.time() < t_deadline:
+            rows = _read_journal(exp_dir)
+            clean = [r for r in rows if r["phase"] == "slo_ok"]
+            rejected = [r for r in rows if r["phase"] == "rejected"]
+            if len(clean) >= expected_clean and rejected:
+                break
+            sink.flush()
+            time.sleep(0.5)
+        killer_thread.join(timeout=60)
+
+        # -- forced post-promotion regression -> automatic rollback -----
+        # Armed BEFORE the regressing candidate exists, so the ordering
+        # is deterministic: the harness drops one more valid candidate
+        # (fresh init weights + recorded val stats), the daemon promotes
+        # it, the publish arms nan_next_logits via promotion_applied,
+        # live traffic goes non-finite inside the SLO window, and the
+        # daemon rolls the fleet back to the retained last-known-good.
+        import jax as _jax
+
+        from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+            publish_done_marker,
+        )
+
+        log("arming regress_after_promote + dropping the bad candidate")
+        faultinject.activate(
+            faultinject.FaultPlan(regress_after_promote=8)
+        )
+        bad_path = os.path.join(
+            exp_dir, "saved_models", f"train_model_{int(epochs) + 40}"
+        )
+        learner.save_model(
+            bad_path, learner.init_state(_jax.random.PRNGKey(7)),
+            {"current_iter": 999, "best_val_acc": 0.9,
+             "per_epoch_statistics": {"val_accuracy_mean": [0.9]}},
+        )
+        publish_done_marker(bad_path)
+        rollback_seen = False
+        while time.time() < t_deadline:
+            rows = _read_journal(exp_dir)
+            if any(r["phase"] == "rolled_back" for r in rows):
+                rollback_seen = True
+                break
+            sink.flush()
+            time.sleep(0.5)
+        sink.flush()
+        verdict["rollback_seen"] = rollback_seen
+    finally:
+        stop_traffic.set()
+        try:
+            faultinject.deactivate()
+        except Exception:  # noqa: BLE001
+            pass
+        if daemon_proc is not None:
+            proc = daemon_proc.get("proc")
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+        try:
+            traffic_thread.join(timeout=60)
+        except Exception:  # noqa: BLE001
+            pass
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            server_thread.join(timeout=10)
+        pool.close()
+        tel_events.install(previous_sink)
+        sink.flush()
+        if previous_dataset_dir is None:
+            os.environ.pop("DATASET_DIR", None)
+        else:
+            os.environ["DATASET_DIR"] = previous_dataset_dir
+
+    # -- verdict --------------------------------------------------------
+    rows = _read_journal(exp_dir)
+    events = _read_events(exp_dir)
+    promoted_rows = [r for r in rows if r["phase"] == "promoted"]
+    clean_digests = [r["digest"] for r in rows if r["phase"] == "slo_ok"]
+    rejected = [r for r in rows if r["phase"] == "rejected"]
+    rolled = [r for r in rows if r["phase"] == "rolled_back"]
+    # No double-promote across the daemon SIGKILL: at most one promoted
+    # row per digest unless explicitly marked resumed.
+    digest_counts: dict = {}
+    for r in promoted_rows:
+        digest_counts[r["digest"]] = digest_counts.get(r["digest"], 0) + 1
+    double_promoted = [
+        d for d, n in digest_counts.items()
+        if n > 1 and not any(
+            r.get("resumed") for r in promoted_rows if r["digest"] == d
+        )
+    ]
+    offered = sum(r["offered"] for r in loadtest_results)
+    ok_requests = sum(r["completed_ok"] for r in loadtest_results)
+    slo_pass = bool(loadtest_results) and all(
+        r["slo_pass"] for r in loadtest_results
+    )
+    corrupt_rejections = [
+        r for r in rejected if r["reason"] in ("corrupt", "digest_mismatch")
+    ]
+    rollback_to_lkg = bool(
+        rolled and clean_digests and rolled[-1].get("to") == clean_digests[-1]
+    )
+    # Feedback edge: the run's own telemetry mines into a replay manifest.
+    mined = 0
+    try:
+        from tools.episode_miner import mine_events, select_hard_episodes
+
+        mined = len(select_hard_episodes(
+            mine_events(events), max_margin=1.0, top=64
+        ))
+    except Exception:  # noqa: BLE001 — verdict field stays 0
+        pass
+    verdict.update({
+        "devices": 1,
+        "completed": verdict.get("trainer_completed", False),
+        "promotions": len(clean_digests),
+        "promoted_digests": sorted(set(r["digest"] for r in promoted_rows)),
+        "corrupt_rejected": len(corrupt_rejections),
+        "rejected_reasons": sorted(r["reason"] for r in rejected),
+        "rollback_to_lkg": rollback_to_lkg,
+        "double_promoted": double_promoted,
+        "daemon_restarted": True,
+        "loadtest_offered": offered,
+        "loadtest_ok": ok_requests,
+        "loadtest_failed": offered - ok_requests,
+        "loadtest_slo_pass": slo_pass,
+        "mined_episodes": mined,
+        "telemetry_promotion_events": sorted({
+            e["type"] for e in events
+            if str(e.get("type", "")).startswith("promotion")
+            or str(e.get("type", "")).startswith("slo_")
+        }),
+        "ok": bool(
+            verdict.get("trainer_completed")
+            and len(clean_digests) >= 3
+            and corrupt_rejections
+            and verdict.get("rollback_seen")
+            and rollback_to_lkg
+            and not double_promoted
+            and slo_pass
+            and offered > 0
+            and offered == ok_requests
+            and mined > 0
+        ),
+    })
+    if not verdict["ok"] and verbose:
+        log(f"verdict: {json.dumps(verdict, indent=1)}")
+    return verdict
+
+
 def measure_multihost_recovery(seed: int = 0) -> dict:
     """Bench hook behind the ``multihost_recovery_s`` standard-emission
     key: one kill-a-host chaos run through the real dispatcher CLI on a
@@ -699,9 +1081,14 @@ def main(argv=None) -> int:
     parser.add_argument("--schedule", default="auto",
                         help="comma-separated fault classes "
                              f"{FAULT_CLASSES}, 'auto' (seeded shuffle of "
-                             "all six), or 'killhost' (alone: SIGKILL one "
+                             "all six), 'killhost' (alone: SIGKILL one "
                              "worker of a 2-process fleet driven through "
-                             "the dispatcher — the host-loss class)")
+                             "the dispatcher — the host-loss class), or "
+                             "'promote' (alone: the continuous train→serve "
+                             "loop — trainer + promotion daemon + "
+                             "2-replica pool + loadtest through automatic "
+                             "promotions, corrupt-candidate rejection and "
+                             "a forced SLO rollback)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--devices", type=int, default=1,
                         help="virtual CPU mesh devices (dp extent); hangs "
@@ -738,6 +1125,14 @@ def main(argv=None) -> int:
             verdict = run_killhost_chaos(workdir, verbose=not args.json)
         elif "killhost" in schedule:
             parser.error("killhost runs alone: --schedule killhost")
+        elif schedule == ["promote"]:
+            # The continuous train→serve loop: trainer + promotion daemon
+            # + 2-replica pool + loadtest concurrently, through >= 3
+            # automatic promotions, one corrupt-candidate rejection and
+            # one forced post-promotion rollback — its own harness.
+            verdict = run_promote_chaos(workdir, verbose=not args.json)
+        elif "promote" in schedule:
+            parser.error("promote runs alone: --schedule promote")
         else:
             verdict = run_chaos(
                 workdir, schedule, devices=args.devices,

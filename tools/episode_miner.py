@@ -1,0 +1,175 @@
+"""Hard-episode miner: serving telemetry -> training replay manifest.
+
+The feedback half of the train→serve loop: the serving engine stamps
+per-episode prediction confidence (softmax top1-top2 margin and
+predictive entropy) plus the client's opaque tag onto every
+``serve_dispatch`` telemetry event — host-side, zero extra device syncs.
+Clients that drew their episode from the dataset distribution tag it
+``"seed:<int>"`` (the dataset synthesizes episodes as pure functions of
+that seed), which is exactly enough identity to REPLAY the episode into
+the training stream: this tool selects the lowest-margin tagged episodes
+and writes a replay manifest the loader mixes in
+(``--replay_manifest``/``--replay_every`` — every Nth training episode
+slot draws a mined seed instead of the next fresh one, deterministically,
+so resume/bit-exactness contracts are untouched).
+
+Usage::
+
+    python tools/episode_miner.py --telemetry <exp>/logs/telemetry.jsonl \
+        --out replay_manifest.json [--max-margin 0.5] [--top 64] \
+        [--min-count 1] [--json]
+
+Then train with::
+
+    python train_maml_system.py --name_of_args_json_file cfg.json \
+        --replay_manifest replay_manifest.json --replay_every 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MANIFEST_SCHEMA = 1
+
+#: Tag prefix that makes an episode replayable: the integer after it is
+#: the dataset synthesis seed.
+SEED_TAG_PREFIX = "seed:"
+
+
+def mine_events(events) -> dict[int, dict]:
+    """Folds ``serve_dispatch`` events into per-seed confidence stats:
+    ``{seed: {"margin": min_margin, "entropy": max_entropy, "count": n}}``.
+    Episodes without a parseable ``seed:<int>`` tag are skipped (no
+    replayable identity); non-finite margins (a NaN-logits episode) are
+    treated as margin 0.0 — maximally hard."""
+    out: dict[int, dict] = {}
+    for event in events:
+        if event.get("type") != "serve_dispatch":
+            continue
+        tags = event.get("tags") or []
+        margins = event.get("margins") or []
+        entropies = event.get("entropies") or []
+        for i, tag in enumerate(tags):
+            if not isinstance(tag, str) or not tag.startswith(SEED_TAG_PREFIX):
+                continue
+            try:
+                seed = int(tag[len(SEED_TAG_PREFIX):])
+            except ValueError:
+                continue
+            margin = margins[i] if i < len(margins) else None
+            entropy = entropies[i] if i < len(entropies) else None
+            margin = (
+                float(margin)
+                if isinstance(margin, (int, float)) and math.isfinite(margin)
+                else 0.0
+            )
+            entropy = (
+                float(entropy)
+                if isinstance(entropy, (int, float)) and math.isfinite(entropy)
+                else None
+            )
+            row = out.setdefault(
+                seed, {"margin": margin, "entropy": entropy, "count": 0}
+            )
+            row["count"] += 1
+            row["margin"] = min(row["margin"], margin)
+            if entropy is not None:
+                row["entropy"] = max(row["entropy"] or 0.0, entropy)
+    return out
+
+
+def select_hard_episodes(
+    stats: dict[int, dict],
+    *,
+    max_margin: float = 0.5,
+    top: int = 64,
+    min_count: int = 1,
+) -> list[dict]:
+    """Lowest-margin episodes first, filtered to ``margin <= max_margin``
+    and at least ``min_count`` sightings, capped at ``top``."""
+    rows = [
+        {"seed": seed, **row}
+        for seed, row in stats.items()
+        if row["margin"] <= max_margin and row["count"] >= min_count
+    ]
+    rows.sort(key=lambda r: (r["margin"], r["seed"]))
+    return rows[: max(int(top), 0)]
+
+
+def write_manifest(path: str, episodes: list[dict], source: str) -> dict:
+    manifest = {
+        "schema": MANIFEST_SCHEMA,
+        "source": source,
+        "episodes": episodes,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, path)
+    return manifest
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--telemetry", required=True,
+                        help="telemetry JSONL with serve_dispatch events")
+    parser.add_argument("--out", required=True,
+                        help="replay manifest JSON to write")
+    parser.add_argument("--max-margin", type=float, default=0.5,
+                        help="only episodes at or below this softmax "
+                        "top1-top2 margin are mined")
+    parser.add_argument("--top", type=int, default=64,
+                        help="manifest size cap (lowest margins first)")
+    parser.add_argument("--min-count", type=int, default=1,
+                        help="minimum sightings before an episode is mined")
+    parser.add_argument("--json", action="store_true",
+                        help="print the manifest summary as one JSON line")
+    opts = parser.parse_args(argv)
+
+    from howtotrainyourmamlpytorch_tpu.telemetry.events import read_events
+
+    events = read_events(opts.telemetry)
+    stats = mine_events(events)
+    episodes = select_hard_episodes(
+        stats, max_margin=opts.max_margin, top=opts.top,
+        min_count=opts.min_count,
+    )
+    summary = {
+        "tagged_episodes": len(stats),
+        "mined": len(episodes),
+        "out": opts.out if episodes else None,
+        "min_margin": episodes[0]["margin"] if episodes else None,
+    }
+    if not episodes:
+        # Nothing cleared the gates: write NO manifest and exit non-zero
+        # — the loader refuses empty manifests, so a scripted
+        # mine-then-train pipeline must branch here, not start a training
+        # run that dies at loader construction.
+        if opts.json:
+            print(json.dumps(summary))
+        else:
+            print(
+                f"no episodes at or below margin {opts.max_margin} "
+                f"(of {len(stats)} tagged) — no manifest written",
+                file=sys.stderr,
+            )
+        return 3
+    write_manifest(opts.out, episodes, source=os.path.abspath(opts.telemetry))
+    if opts.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"mined {summary['mined']} hard episode(s) of "
+            f"{summary['tagged_episodes']} tagged -> {opts.out}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,180 @@
+"""Promotion daemon CLI: the continuous train→serve control loop.
+
+Watches a trainer's checkpoint directory (``saved_models/``) for fully-
+published epoch checkpoints (``.ready`` done-markers), stages + verifies +
+val-gates each candidate, drives the fleet's canary-first
+``/admin/promote`` with retry/backoff, journals every phase to a
+crash-safe ``logs/promotions.jsonl`` (SIGKILL at any boundary, restart,
+and the run resumes idempotently — no double-promote, no skipped
+candidate), and after every publish watches the front door's ``/metrics``
+for live regression — rolling back to the retained last-known-good
+checkpoint automatically.
+
+Usage::
+
+    python tools/promotion_daemon.py \
+        --watch <experiment>/saved_models \
+        --target http://127.0.0.1:8080 \
+        [--journal <experiment>/logs/promotions.jsonl] \
+        [--staging <experiment>/promotion_staging] \
+        [--telemetry <experiment>/logs/telemetry.jsonl] \
+        [--poll_interval_s 2.0] [--val_stat val_accuracy_mean] \
+        [--val_min_delta 0.0] [--allow_missing_val_stat] \
+        [--slo_watch_s 10] [--slo_poll_s 0.5] \
+        [--p99_budget_ms 30000] [--max_error_rate 0.05] \
+        [--max_new_nonfinite 0] [--min_requests 1] \
+        [--promote_retries 3] [--promote_backoff_s 0.5] \
+        [--max_promotions 0] [--once]
+
+Runs until SIGTERM/SIGINT (clean close: both daemon threads joined),
+``--once`` (single scan pass — scripting/tests), or ``--max_promotions N``
+resolved publishes. Telemetry events (``promotion_promoted``,
+``promotion_rejected``, ``slo_regression``, ``slo_rollback``, ...) append
+to the experiment's own JSONL stream so ``tools/telemetry_report.py``
+shows the control plane inline with the trainer and the fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_daemon(opts):
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.promotion import (
+        HttpTarget,
+        PromotionConfig,
+        PromotionDaemon,
+    )
+
+    watch_dir = os.path.abspath(opts.watch)
+    exp_dir = os.path.dirname(watch_dir)
+    journal = opts.journal or os.path.join(
+        exp_dir, "logs", "promotions.jsonl"
+    )
+    staging = opts.staging or os.path.join(exp_dir, "promotion_staging")
+    config = PromotionConfig(
+        watch_dir=watch_dir,
+        journal_path=journal,
+        staging_dir=staging,
+        poll_interval_s=opts.poll_interval_s,
+        val_stat_key=opts.val_stat,
+        require_val_stat=not opts.allow_missing_val_stat,
+        val_min_delta=opts.val_min_delta,
+        promote_retries=opts.promote_retries,
+        promote_backoff_s=opts.promote_backoff_s,
+        slo_watch_s=opts.slo_watch_s,
+        slo_poll_s=opts.slo_poll_s,
+        p99_budget_ms=opts.p99_budget_ms,
+        max_error_rate=opts.max_error_rate,
+        max_new_nonfinite=opts.max_new_nonfinite,
+        min_requests=opts.min_requests,
+    )
+    return PromotionDaemon(HttpTarget(opts.target), config)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--watch", required=True,
+                        help="trainer checkpoint dir (…/saved_models)")
+    parser.add_argument("--target", required=True,
+                        help="serving front-door base URL "
+                        "(http://host:port)")
+    parser.add_argument("--journal", default=None,
+                        help="promotions journal path (default: "
+                        "<exp>/logs/promotions.jsonl)")
+    parser.add_argument("--staging", default=None,
+                        help="staged-candidate retention dir (default: "
+                        "<exp>/promotion_staging)")
+    parser.add_argument("--telemetry", default=None,
+                        help="telemetry JSONL to append control-plane "
+                        "events to (default: <exp>/logs/telemetry.jsonl; "
+                        "'none' disables)")
+    parser.add_argument("--poll_interval_s", type=float, default=2.0)
+    parser.add_argument("--val_stat", default="val_accuracy_mean",
+                        help="experiment statistic the val-gate reads")
+    parser.add_argument("--val_min_delta", type=float, default=None,
+                        help="candidate must beat last-known-good's stat "
+                        "by this much (unset: presence-only gate)")
+    parser.add_argument("--allow_missing_val_stat", action="store_true",
+                        help="promote candidates with no recorded val "
+                        "stat (default: reject them)")
+    parser.add_argument("--slo_watch_s", type=float, default=10.0)
+    parser.add_argument("--slo_poll_s", type=float, default=0.5)
+    parser.add_argument("--p99_budget_ms", type=float, default=30_000.0)
+    parser.add_argument("--max_error_rate", type=float, default=0.05)
+    parser.add_argument("--max_new_nonfinite", type=int, default=0)
+    parser.add_argument("--min_requests", type=int, default=1)
+    parser.add_argument("--promote_retries", type=int, default=3)
+    parser.add_argument("--promote_backoff_s", type=float, default=0.5)
+    parser.add_argument("--max_promotions", type=int, default=0,
+                        help="exit after N resolved publishes (0 = run "
+                        "until signaled)")
+    parser.add_argument("--once", action="store_true",
+                        help="one scan/process pass, then exit")
+    opts = parser.parse_args(argv)
+
+    from howtotrainyourmamlpytorch_tpu.telemetry import events as tel_events
+    from howtotrainyourmamlpytorch_tpu.telemetry.events import EventLog
+
+    exp_dir = os.path.dirname(os.path.abspath(opts.watch))
+    telemetry_path = opts.telemetry or os.path.join(
+        exp_dir, "logs", "telemetry.jsonl"
+    )
+    sink = None
+    if telemetry_path != "none":
+        os.makedirs(os.path.dirname(telemetry_path), exist_ok=True)
+        sink = EventLog(telemetry_path)
+        tel_events.install(sink)
+        tel_events.ensure_trace_id()  # join MAML_TRACE_ID when exported
+
+    daemon = build_daemon(opts)
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    previous = {}
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            previous[signum] = signal.signal(signum, _graceful)
+        except (ValueError, OSError):
+            pass
+    try:
+        if opts.once:
+            daemon.slo.start()
+            daemon.run_once()
+        else:
+            daemon.start()
+            print(
+                f"promotion daemon watching {opts.watch} -> {opts.target} "
+                f"(journal {daemon.config.journal_path})",
+                flush=True,
+            )
+            while not stop.is_set():
+                if (
+                    opts.max_promotions
+                    and daemon.resolved_promotions >= opts.max_promotions
+                ):
+                    break
+                stop.wait(0.2)
+    finally:
+        daemon.close()
+        if sink is not None:
+            sink.flush()
+            tel_events.install(None)
+        for signum, handler in previous.items():
+            try:
+                signal.signal(signum, handler)
+            except (ValueError, OSError):
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

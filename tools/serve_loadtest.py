@@ -116,12 +116,17 @@ def synth_episodes(
     return episodes
 
 
-def _classify_outcome(target, episode, timeout_s: float) -> str:
+def _classify_outcome(
+    target, episode, timeout_s: float, tag: str | None = None
+) -> str:
     from howtotrainyourmamlpytorch_tpu.serve.errors import OverloadedError
 
     xs, ys, xq = episode
     try:
-        target.classify(xs, ys, xq, timeout=timeout_s)
+        if tag is not None:
+            target.classify(xs, ys, xq, timeout=timeout_s, tag=tag)
+        else:
+            target.classify(xs, ys, xq, timeout=timeout_s)
         return OUTCOME_OK
     except OverloadedError:
         return OUTCOME_SHED
@@ -143,6 +148,7 @@ def run_loadtest(
     seed: int = 0,
     max_workers: int = 32,
     sample_health: bool = True,
+    tag_seed_base: int | None = None,
 ) -> dict:
     """Offers an open-loop Poisson stream to ``target.classify`` and
     returns the measured result + SLO verdict (see module docstring).
@@ -151,7 +157,10 @@ def run_loadtest(
     surface (a pool, or an ``HttpReplica`` pointed at a live server).
     ``episodes`` are cycled round-robin, so distinct support sets keep the
     adapt path honest (pass one episode to measure the pure cache-hit
-    tier)."""
+    tier). ``tag_seed_base`` stamps episode ``i`` with the telemetry tag
+    ``seed:<base+i>`` — the replayable identity ``tools/episode_miner.py``
+    mines hard episodes by (use the dataset seeds your episodes were
+    actually synthesized from when you have them)."""
     rng = np.random.RandomState(seed)
     # The whole arrival schedule up front: reproducible, and the firing
     # loop does no RNG work.
@@ -166,8 +175,13 @@ def run_loadtest(
     t_start = time.monotonic()
 
     def fire(index: int, due: float) -> None:
+        slot = index % len(episodes)
+        tag = (
+            f"seed:{tag_seed_base + slot}"
+            if tag_seed_base is not None else None
+        )
         outcome = _classify_outcome(
-            target, episodes[index % len(episodes)], timeout_s
+            target, episodes[slot], timeout_s, tag=tag
         )
         # Latency is measured from the SCHEDULED arrival, not from when an
         # executor worker got around to the task — client-side queueing
@@ -326,6 +340,9 @@ def main(argv=None) -> int:
     parser.add_argument("--image-shape", default="1x28x28",
                         help="CxHxW image geometry for --url targets "
                         "(must match the served model)")
+    parser.add_argument("--tag-seed-base", type=int, default=None,
+                        help="stamp episode i with telemetry tag "
+                        "'seed:<base+i>' (the episode_miner identity)")
     parser.add_argument("--kill-replica-at", type=int, default=None,
                         help="inject replica death at the Kth request "
                         "(in-process targets) and measure recovery")
@@ -373,6 +390,7 @@ def main(argv=None) -> int:
             error_slo=opts.error_slo,
             timeout_s=opts.timeout_s,
             seed=opts.seed,
+            tag_seed_base=opts.tag_seed_base,
         )
     finally:
         if opts.kill_replica_at is not None:

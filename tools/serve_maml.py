@@ -17,7 +17,7 @@ Usage::
         [--max_batch 4] [--max_wait_ms 2.0] [--cache_capacity 256] \
         [--max_queue_depth 64] [--degrade_queue_depth 16] \
         [--warmup 5x1x15,5x5x15] [--init_from_scratch] \
-        [--replicas 2]
+        [--replicas 2] [--telemetry logs/serve_telemetry.jsonl]
 
 Then::
 
@@ -115,6 +115,7 @@ def build_pool(opts):
             checkpoint=opts.checkpoint,
             learner=opts.learner,
             warmup=opts.warmup,
+            telemetry=opts.telemetry,
             max_batch=opts.max_batch,
             max_wait_ms=opts.max_wait_ms,
             cache_capacity=opts.cache_capacity,
@@ -174,6 +175,13 @@ def main(argv=None) -> int:
     parser.add_argument("--warmup", default="",
                         help="comma-separated WAYxSHOTxQUERY buckets to "
                         "pre-compile before accepting traffic")
+    parser.add_argument("--telemetry", default=None,
+                        help="append serve telemetry events "
+                        "(serve_dispatch with per-episode margin/entropy/"
+                        "tags, serve_compile, swap/promotion events) to "
+                        "this JSONL; --replicas workers share the path "
+                        "(concurrent appends are reader-tolerated) — the "
+                        "feed tools/episode_miner.py mines")
     parser.add_argument("--init_from_scratch", action="store_true",
                         help="serve fresh init weights (no checkpoint)")
     parser.add_argument("--replicas", type=int, default=0,
@@ -182,6 +190,36 @@ def main(argv=None) -> int:
     parser.add_argument("--health_interval_s", type=float, default=0.5)
     parser.add_argument("--restart_backoff_s", type=float, default=1.0)
     opts = parser.parse_args(argv)
+    telemetry_stop = None
+    telemetry_flusher = None
+    telemetry_sink = None
+    if opts.telemetry:
+        # Engines emit host-buffered events (serve/engine.py); a serving
+        # process has no trainer forced-read boundary to flush at, so a
+        # small cadence thread drains the buffer instead (joined on every
+        # exit path below — thread-lifecycle).
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            events as tel_events,
+        )
+        from howtotrainyourmamlpytorch_tpu.telemetry.events import EventLog
+
+        parent = os.path.dirname(os.path.abspath(opts.telemetry))
+        os.makedirs(parent, exist_ok=True)
+        telemetry_sink = EventLog(opts.telemetry)
+        tel_events.install(telemetry_sink)
+        tel_events.ensure_trace_id()
+        telemetry_stop = threading.Event()
+
+        def _flush_loop():
+            while not telemetry_stop.is_set():
+                telemetry_sink.flush()
+                telemetry_stop.wait(1.0)
+            telemetry_sink.flush()
+
+        telemetry_flusher = threading.Thread(
+            target=_flush_loop, name="serve-telemetry-flusher", daemon=True
+        )
+        telemetry_flusher.start()
     if not opts.checkpoint and not opts.init_from_scratch:
         parser.error("--checkpoint is required (or pass --init_from_scratch)")
     if opts.replicas > 0 and not opts.warmup:
@@ -276,6 +314,9 @@ def main(argv=None) -> int:
         signal.signal(signal.SIGTERM, previous_sigterm)
         server.server_close()
         target.close()
+        if telemetry_stop is not None:
+            telemetry_stop.set()
+            telemetry_flusher.join(timeout=10)
     return 0
 
 
