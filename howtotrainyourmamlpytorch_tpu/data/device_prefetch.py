@@ -160,6 +160,9 @@ class DevicePrefetcher:
         self._fault_budget = int(fault_budget)
         self.faults_quarantined = 0
 
+        # One mutex, two wait-sets: graftlint's lock model aliases
+        # Condition(self._lock) to the shared lock, so producer/consumer
+        # nesting here can never read as a multi-lock ordering.
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)

@@ -410,6 +410,10 @@ class ExperimentBuilder:
             except CheckpointCorruptError as exc:
                 quarantined = path + ".corrupt"
                 try:
+                    # graftlint: disable=chief-only-write -- every rank
+                    # may quarantine a corrupt resume candidate: the
+                    # replace is atomic, and a rank losing the race gets
+                    # FileNotFoundError, tolerated right below.
                     os.replace(path, quarantined)
                 except FileNotFoundError:
                     pass  # vanished concurrently (pruner / duplicate job)
@@ -444,11 +448,18 @@ class ExperimentBuilder:
         if self._shutdown_signum is not None:
             raise KeyboardInterrupt  # second signal: stop immediately
         self._shutdown_signum = signum
-        print(
-            f"\nreceived signal {signum}: finishing the in-flight dispatch, "
-            "then emergency checkpoint + requeue exit "
-            f"({REQUEUE_EXIT_CODE})",
-            flush=True,
+        # os.write, not print: handlers run on the main thread between
+        # bytecodes, and a signal landing while that thread is inside a
+        # buffered print dies with "RuntimeError: reentrant call" — which
+        # would crash the run instead of the graceful requeue
+        # (signal-handler-unsafe).
+        os.write(
+            2,
+            (
+                f"\nreceived signal {signum}: finishing the in-flight "
+                "dispatch, then emergency checkpoint + requeue exit "
+                f"({REQUEUE_EXIT_CODE})\n"
+            ).encode(),
         )
 
     def _write_interruption_row(self, kind=None) -> None:
@@ -492,6 +503,10 @@ class ExperimentBuilder:
                 row = row[: len(existing)]
         except OSError:
             pass
+        # graftlint: disable=chief-only-write -- interruption audit rows
+        # are per-rank BY DESIGN (the process_index/process_count columns
+        # attribute a multi-host fault to the rank that saw it); the
+        # O_EXCL header create above settles the one shared-create race.
         save_statistics(
             self.logs_filepath, row, filename="interruptions.csv",
         )

@@ -44,6 +44,10 @@ def load_native_library(name: str) -> ctypes.CDLL | None:
                     raise RuntimeError("no C compiler on PATH")
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 tmp = out + f".tmp{os.getpid()}"
+                # graftlint: disable=blocking-under-lock -- serializing
+                # concurrent native builds is this lock's entire job: the
+                # compiler must finish before a second thread may probe
+                # the output; nothing on any hot path contends it.
                 subprocess.run(
                     [cc, "-O3", "-shared", "-fPIC", src, "-o", tmp],
                     check=True, capture_output=True,

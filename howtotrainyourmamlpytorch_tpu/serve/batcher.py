@@ -15,7 +15,10 @@ One worker thread owns all dispatching; callers block on a
 ``concurrent.futures.Future`` so the public API stays synchronous while
 arbitrarily many frontend threads (the HTTP handler pool) share one device
 pipeline. Dispatch runs OUTSIDE the queue lock — enqueue latency never
-includes device time.
+includes device time. (That invariant is now mechanically enforced:
+graftlint's ``blocking-under-lock`` flags a jitted dispatch reachable
+under the Condition, and tier-1 runs this module's suites under the
+``utils/locksan.py`` hold-time budget.)
 
 Resilience contract (serve/errors.py): the worker thread is FENCED. An
 exception anywhere in a group's dispatch — a poisoned episode deep in the

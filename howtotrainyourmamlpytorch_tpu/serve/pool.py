@@ -506,9 +506,15 @@ class ReplicaPool:
                     reason=exc.reason,
                 ) from exc
             promoted += 1
+        # Hash OUTSIDE the lock: the first digest of a multi-MB archive is
+        # real file I/O, and holding the pool Condition across it would
+        # park every dispatcher/_pick caller behind the hash
+        # (blocking-under-lock; the memo makes repeats cheap, not the
+        # first read).
+        digest = checkpoint_digest(checkpoint_path)
         with self._lock:
             self._last_promoted = {
-                "digest": checkpoint_digest(checkpoint_path),
+                "digest": digest,
                 "path": checkpoint_path,
                 "t": time.time(),
             }

@@ -155,6 +155,10 @@ class EventLog:
         it must never crash a training run the fault-tolerance runtime was
         built to keep alive, and never turn a clean preemption-requeue
         exit (code 75) into a crash."""
+        # Swap the buffer under the lock, serialize + write OUTSIDE it:
+        # every hot-path emitter contends this lock, and holding it
+        # across file I/O would serialize them behind the disk
+        # (graftlint blocking-under-lock pins the shape).
         with self._lock:
             batch, self._buffer = self._buffer, []
             if not batch:

@@ -261,3 +261,61 @@ def multihost_cpu_guard(tmp_path_factory):
             f"backend ({detail}) — multi-host tests are probe-guarded so "
             f"an unsupported jaxlib cannot hang the suite:\n{tail}"
         )
+
+
+# ---------------------------------------------------------------------------
+# Lock-order sanitizer (runtime twin of graftlint v2, utils/locksan.py)
+# ---------------------------------------------------------------------------
+#
+# Usage:   with locksan() as san: <run concurrent code>
+#          san.assert_clean(hold_budget_s=0.5, match="serve")
+# The sanitizer instruments every threading.Lock/RLock CREATED inside the
+# with-block (Condition and queue.Queue build on those factories) and
+# records the acquisition-order graph + per-site hold times; a cycle in
+# the graph is a potential deadlock that really happened in this
+# process's lock nesting — no lucky schedule required.
+#
+# Tier-1 additionally runs the serve/chaos suites UNDER the sanitizer
+# (the autouse fixture below): every in-process pool/batcher/engine test
+# doubles as a deadlock + hold-budget proof. Overhead on the serve hot
+# path is measured < 2% (PERF_NOTES.md "Lock sanitizer overhead").
+
+#: Test modules whose every test runs under the sanitizer. These are the
+#: suites exercising the real concurrent serving/chaos machinery
+#: in-process — exactly where an inversion would bite production.
+_LOCKSAN_SUITES = {
+    "test_serve_runtime",
+    "test_serve_resilience",
+    "test_serve_http",
+    "test_chaos_train",
+    "test_promotion",
+}
+
+#: Hold budget for serve-plane locks while sanitized: the serving hot
+#: path's critical sections are dict/list operations (the batcher
+#: dispatches OUTSIDE its lock; engine compiles outside too), so even a
+#: heavily-loaded CI host stays orders of magnitude under this.
+_LOCKSAN_SERVE_HOLD_BUDGET_S = 2.0
+
+
+@pytest.fixture
+def locksan():
+    from howtotrainyourmamlpytorch_tpu.utils.locksan import LockSanitizer
+
+    return LockSanitizer
+
+
+@pytest.fixture(autouse=True)
+def _locksan_on_serve_suites(request):
+    module = os.path.splitext(os.path.basename(str(request.node.fspath)))[0]
+    if module not in _LOCKSAN_SUITES:
+        yield None
+        return
+    from howtotrainyourmamlpytorch_tpu.utils.locksan import LockSanitizer
+
+    with LockSanitizer() as san:
+        yield san
+    san.assert_clean(
+        hold_budget_s=_LOCKSAN_SERVE_HOLD_BUDGET_S,
+        match=os.path.join("howtotrainyourmamlpytorch_tpu", "serve"),
+    )

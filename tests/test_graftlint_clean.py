@@ -1,21 +1,149 @@
 """Tier-1 gate: the whole tree lints clean, forever.
 
-Runs the real CLI (``python -m tools.graftlint``) over the same surface a CI
-step would, so no separate CI config is needed — a new violation anywhere in
-``howtotrainyourmamlpytorch_tpu/``, ``tests/`` or ``tools/`` fails the
-suite. Also pins the CLI contract itself: non-zero exit on violations,
-``--format=github`` annotations, ``--list-rules``.
+Runs the real CLI (``python -m tools.graftlint``) over the same surface a
+CI step would, so no separate CI config is needed — a new violation
+anywhere in ``howtotrainyourmamlpytorch_tpu/``, ``tests/`` or ``tools/``
+fails the suite. Also pins the CLI contract itself (non-zero exit,
+``--format=github`` annotations incl. the v2 concurrency rules,
+``--list-rules``) and keeps the README rule table in sync with the live
+registry.
+
+The per-plane standalone pins that used to be eight near-identical test
+functions (one of which shadowed another by sharing its name — exactly
+the duplication this table removes) are ONE parametrized in-process test
+over :data:`PLANES`: same coverage (explicit target lists that survive a
+LINT_TARGETS reshuffle, discovery assertions so an empty scan can't
+vacuously pass, zero-suppression scans where a plane must be clean on
+its own merits), a fraction of the walltime (no per-plane subprocess).
 """
 
+import json
 import os
+import re
 import subprocess
 import sys
+import tempfile
+import textwrap
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-# The package target covers every subpackage (incl. the serving runtime,
-# howtotrainyourmamlpytorch_tpu/serve/ — pinned explicitly below so a
-# future target-list refactor can't silently drop the new subsystem).
-LINT_TARGETS = ["howtotrainyourmamlpytorch_tpu", "tests", "tools"]
+# The package target covers every subpackage; entry files at the repo
+# root (train_*.py, bench.py) ride the planes below AND the explicit
+# list here so the tree-wide CLI gate scans them too.
+LINT_TARGETS = [
+    "howtotrainyourmamlpytorch_tpu", "tests", "tools",
+    "train_maml_system.py", "train_gradient_descent_system.py",
+    "train_matching_nets_system.py", "train_maml_system_dispatch.py",
+    "bench.py",
+]
+
+PKG = "howtotrainyourmamlpytorch_tpu"
+
+#: plane -> {targets, expect (basenames the scan must discover),
+#: zero_suppressions}. One entry per subsystem a past PR pinned; the
+#: parametrized test below is the single implementation.
+PLANES = {
+    "serve": {
+        "targets": [
+            f"{PKG}/serve", "tools/serve_maml.py", "tools/serve_bench.py",
+        ],
+        "expect": {"engine.py", "batcher.py", "cache.py", "api.py",
+                   "metrics.py"},
+        "zero_suppressions": False,  # ISSUE 4 predates the zero-sup pins
+    },
+    "telemetry": {
+        "targets": [f"{PKG}/telemetry", "tools/telemetry_report.py"],
+        "expect": {"registry.py", "events.py", "profiling.py", "runtime.py",
+                   "heartbeat.py", "anomaly.py", "telemetry_report.py"},
+        "zero_suppressions": True,
+    },
+    "serve-resilience": {
+        "targets": [
+            f"{PKG}/serve/resilience", f"{PKG}/serve/pool.py",
+            f"{PKG}/serve/errors.py", "tools/serve_loadtest.py",
+        ],
+        "expect": {"admission.py", "swap.py", "replica.py", "pool.py",
+                   "errors.py", "serve_loadtest.py"},
+        "zero_suppressions": True,
+    },
+    "device-prefetch": {
+        # Its ``jax.device_put`` is the ONE sanctioned exception to
+        # device-op-in-data-path, granted via the rule's own allowlist —
+        # an inline suppression would weaken the data-path ban.
+        "targets": [f"{PKG}/data/device_prefetch.py"],
+        "expect": {"device_prefetch.py"},
+        "zero_suppressions": True,
+    },
+    "parallel": {
+        "targets": [f"{PKG}/parallel"],
+        "expect": {"mesh.py", "sharding.py", "distributed.py",
+                   "multihost.py"},
+        "zero_suppressions": True,
+    },
+    "layout": {
+        "targets": [f"{PKG}/ops/layout.py"],
+        "expect": {"layout.py"},
+        "zero_suppressions": True,
+    },
+    "train-resilience": {
+        # ISSUE 10: watchdog monitor, async checkpoint writer, prefetch
+        # stager and dispatcher all pass thread-lifecycle (spawn + an
+        # owner-reachable join).
+        "targets": [
+            f"{PKG}/utils/watchdog.py", f"{PKG}/utils/checkpoint.py",
+            f"{PKG}/data/device_prefetch.py", "tools/chaos_train.py",
+            "train_maml_system_dispatch.py",
+        ],
+        "expect": {"watchdog.py", "checkpoint.py", "device_prefetch.py",
+                   "chaos_train.py", "train_maml_system_dispatch.py"},
+        "zero_suppressions": True,
+    },
+    "multihost": {
+        # Entry files live at the repo root (outside the default package
+        # targets); this plane is what keeps them scanned forever —
+        # including device-probe-before-distributed-init ordering.
+        "targets": [
+            f"{PKG}/parallel", "train_maml_system.py",
+            "train_gradient_descent_system.py",
+            "train_matching_nets_system.py", "train_maml_system_dispatch.py",
+            "tools/serve_maml.py", "tools/chaos_train.py", "bench.py",
+        ],
+        "expect": {"distributed.py", "mesh.py", "multihost.py",
+                   "train_maml_system.py", "train_maml_system_dispatch.py"},
+        "zero_suppressions": True,
+    },
+    "observability": {
+        "targets": [
+            "tools/bench_judge.py", "tools/telemetry_report.py",
+            f"{PKG}/telemetry", f"{PKG}/utils/watchdog.py",
+            "train_maml_system_dispatch.py", "bench.py",
+        ],
+        "expect": {"bench_judge.py", "telemetry_report.py", "heartbeat.py",
+                   "anomaly.py", "events.py", "runtime.py", "watchdog.py"},
+        "zero_suppressions": True,
+    },
+    "control-plane": {
+        # ISSUE 13: the promotion daemon's watcher/SLO threads carry
+        # owner-reachable joins (thread-lifecycle coverage is live here).
+        "targets": [
+            f"{PKG}/serve/resilience/promotion.py",
+            "tools/promotion_daemon.py", "tools/episode_miner.py",
+            "tools/chaos_train.py",
+        ],
+        "expect": {"promotion.py", "promotion_daemon.py",
+                   "episode_miner.py", "chaos_train.py"},
+        "zero_suppressions": True,
+    },
+    "concurrency": {
+        # ISSUE 14: the analyzer itself and its runtime twin lint clean
+        # under the full rule set (incl. the five rules they implement).
+        "targets": ["tools/graftlint", f"{PKG}/utils/locksan.py"],
+        "expect": {"concurrency.py", "rules.py", "engine.py", "core.py",
+                   "tracing.py", "locksan.py"},
+        "zero_suppressions": True,
+    },
+}
 
 
 def run_cli(*argv: str, cwd: str = REPO) -> subprocess.CompletedProcess:
@@ -47,236 +175,42 @@ def test_in_process_api_agrees_with_cli():
     assert violations == [], [v.format_text() for v in violations]
 
 
-def test_serve_subsystem_lints_clean_standalone():
-    """The serving runtime (ISSUE 4) stays lint-clean as its own target:
-    the whole-package gate above covers it transitively, but this pin makes
-    the coverage explicit and survives any future LINT_TARGETS reshuffle.
-    Also asserts the linter actually DISCOVERED the serve modules (an empty
-    scan would vacuously pass)."""
-    serve_dir = os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "serve")
-    assert os.path.isdir(serve_dir)
-    proc = run_cli(serve_dir, "tools/serve_maml.py", "tools/serve_bench.py")
-    assert proc.returncode == 0, (
-        "graftlint found violations in the serving runtime:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
+@pytest.mark.parametrize("plane", sorted(PLANES))
+def test_plane_lints_clean_standalone(plane):
+    """Each subsystem stays lint-clean as its OWN target: explicit lists
+    survive any LINT_TARGETS reshuffle, the discovery assertion keeps an
+    empty scan from vacuously passing, and zero-suppression planes must
+    be clean on their own merits."""
     from tools.graftlint import lint_paths
     from tools.graftlint.engine import _collect_files
 
-    scanned = {os.path.basename(p) for p in _collect_files([serve_dir])}
-    assert {
-        "engine.py", "batcher.py", "cache.py", "api.py", "metrics.py",
-    } <= scanned
-    assert lint_paths([serve_dir]) == []
-
-
-def test_telemetry_subsystem_lints_clean_standalone():
-    """The telemetry subsystem (ISSUE 5) stays lint-clean as its own target
-    with ZERO suppressions: the whole-package gate covers it transitively,
-    but this pin survives any future LINT_TARGETS reshuffle. Also asserts
-    the linter actually DISCOVERED the telemetry modules (an empty scan
-    would vacuously pass) and that no inline suppressions crept in."""
-    telemetry_dir = os.path.join(
-        REPO, "howtotrainyourmamlpytorch_tpu", "telemetry"
-    )
-    report_tool = os.path.join(REPO, "tools", "telemetry_report.py")
-    assert os.path.isdir(telemetry_dir)
-    proc = run_cli(telemetry_dir, report_tool)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the telemetry subsystem:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-    from tools.graftlint.engine import _collect_files
-
-    scanned = _collect_files([telemetry_dir, report_tool])
-    names = {os.path.basename(p) for p in scanned}
-    assert {
-        "registry.py", "events.py", "profiling.py", "runtime.py",
-        "heartbeat.py", "anomaly.py", "telemetry_report.py",
-    } <= names
-    assert lint_paths([telemetry_dir, report_tool]) == []
-    # Zero suppressions: the subsystem must be clean on its own merits.
-    for path in scanned:
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
-
-
-def test_control_plane_lints_clean_standalone():
-    """The continuous train→serve control plane (ISSUE 13) stays
-    lint-clean as its own target with ZERO suppressions: the promotion
-    daemon module + CLI, the episode miner, and the chaos harness that
-    drives the promote schedule. ``thread-lifecycle`` coverage is live
-    here — the daemon's watcher and SLO-sampler threads both carry
-    owner-reachable joins. Also asserts the linter actually DISCOVERED
-    the modules (an empty scan would vacuously pass)."""
-    targets = [
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "serve",
-                     "resilience", "promotion.py"),
-        os.path.join(REPO, "tools", "promotion_daemon.py"),
-        os.path.join(REPO, "tools", "episode_miner.py"),
-        os.path.join(REPO, "tools", "chaos_train.py"),
-    ]
+    spec = PLANES[plane]
+    targets = [os.path.join(REPO, t) for t in spec["targets"]]
     for target in targets:
         assert os.path.exists(target), target
-    proc = run_cli(*targets)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the promotion control plane:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-    from tools.graftlint.engine import _collect_files
-
     scanned = _collect_files(targets)
     names = {os.path.basename(p) for p in scanned}
-    assert {
-        "promotion.py", "promotion_daemon.py", "episode_miner.py",
-        "chaos_train.py",
-    } <= names
-    assert lint_paths(targets) == []
-    for path in scanned:
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
+    assert spec["expect"] <= names, (plane, names)
+    violations = lint_paths(targets)
+    assert violations == [], [v.format_text() for v in violations]
+    if spec["zero_suppressions"]:
+        # The REAL suppression parser, not a substring grep: the linter's
+        # own sources mention the directive in docstrings/templates
+        # without carrying one.
+        from tools.graftlint.core import _parse_suppressions
+
+        for path in scanned:
+            with open(path) as f:
+                assert _parse_suppressions(f.read()) == [], path
 
 
-def test_observability_plane_lints_clean_standalone():
-    """The fleet observability plane (ISSUE 12) stays lint-clean as its
-    own target with ZERO suppressions: the bench judge + gate data, the
-    fleet report tool, the heartbeat/anomaly modules, and the
-    trace-stamping emitters. Also asserts the linter actually DISCOVERED
-    the modules (an empty scan would vacuously pass)."""
-    targets = [
-        os.path.join(REPO, "tools", "bench_judge.py"),
-        os.path.join(REPO, "tools", "telemetry_report.py"),
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "telemetry"),
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "utils",
-                     "watchdog.py"),
-        os.path.join(REPO, "train_maml_system_dispatch.py"),
-        os.path.join(REPO, "bench.py"),
-    ]
-    for target in targets:
-        assert os.path.exists(target), target
-    # The gate DATA rides next to the judge: it must parse and carry the
-    # schema the judge reads (a malformed gates file would otherwise only
-    # surface on the next judge run).
-    import json as json_module
-
+def test_observability_gate_data_parses():
+    """The judge's gate DATA rides next to it: it must parse and carry
+    the schema the judge reads (a malformed gates file would otherwise
+    only surface on the next judge run)."""
     with open(os.path.join(REPO, "tools", "bench_gates.json")) as f:
-        gates_doc = json_module.load(f)
+        gates_doc = json.load(f)
     assert gates_doc["schema"] == 1 and gates_doc["gates"]
-    proc = run_cli(*targets)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the observability plane:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-    from tools.graftlint.engine import _collect_files
-
-    scanned = _collect_files(targets)
-    names = {os.path.basename(p) for p in scanned}
-    assert {
-        "bench_judge.py", "telemetry_report.py", "heartbeat.py",
-        "anomaly.py", "events.py", "runtime.py", "watchdog.py",
-    } <= names
-    assert lint_paths(targets) == []
-    for path in scanned:
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
-
-
-def test_resilience_layer_lints_clean_standalone():
-    """The serving resilience layer (ISSUE 6) stays lint-clean as its own
-    target with ZERO suppressions: ``serve/pool.py``, the
-    ``serve/resilience`` package, and ``tools/serve_loadtest.py``. The
-    whole-package gate covers them transitively; this pin survives any
-    future LINT_TARGETS reshuffle, asserts the linter actually DISCOVERED
-    the modules (an empty scan would vacuously pass), and refuses inline
-    suppressions."""
-    serve_dir = os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "serve")
-    resilience_dir = os.path.join(serve_dir, "resilience")
-    pool_py = os.path.join(serve_dir, "pool.py")
-    errors_py = os.path.join(serve_dir, "errors.py")
-    loadtest_py = os.path.join(REPO, "tools", "serve_loadtest.py")
-    assert os.path.isdir(resilience_dir)
-    proc = run_cli(
-        resilience_dir, pool_py, errors_py, "tools/serve_loadtest.py"
-    )
-    assert proc.returncode == 0, (
-        "graftlint found violations in the resilience layer:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-    from tools.graftlint.engine import _collect_files
-
-    targets = [resilience_dir, pool_py, errors_py, loadtest_py]
-    scanned = _collect_files(targets)
-    names = {os.path.basename(p) for p in scanned}
-    assert {
-        "admission.py", "swap.py", "replica.py", "pool.py", "errors.py",
-        "serve_loadtest.py",
-    } <= names
-    assert lint_paths(targets) == []
-    # Zero suppressions: the layer must be clean on its own merits.
-    for path in scanned:
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
-
-
-def test_device_prefetch_lints_clean_standalone():
-    """The device-prefetch stager (ISSUE 7) stays lint-clean as its own
-    target with ZERO suppressions. Its ``jax.device_put`` is the one
-    sanctioned exception to ``device-op-in-data-path``, granted via the
-    rule's own allowlist — an inline suppression would weaken the
-    data-path ban for every future edit of the file."""
-    stager_py = os.path.join(
-        REPO, "howtotrainyourmamlpytorch_tpu", "data", "device_prefetch.py"
-    )
-    assert os.path.isfile(stager_py)
-    proc = run_cli(stager_py)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the device-prefetch stager:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-
-    assert lint_paths([stager_py]) == []
-    with open(stager_py) as f:
-        assert "graftlint: disable" not in f.read()
-
-
-def test_layout_module_lints_clean_standalone():
-    """The lane-padded compute layout (ISSUE 9, ``ops/layout.py``) stays
-    lint-clean as its own target with ZERO suppressions: its strip/pad
-    helpers host-numpy-interrogate leaves by design, all of it legal
-    OUTSIDE traces (checkpoint save/restore boundaries only)."""
-    layout_py = os.path.join(
-        REPO, "howtotrainyourmamlpytorch_tpu", "ops", "layout.py"
-    )
-    assert os.path.isfile(layout_py)
-    proc = run_cli(layout_py)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the layout module:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-
-    assert lint_paths([layout_py]) == []
-    with open(layout_py) as f:
-        assert "graftlint: disable" not in f.read()
 
 
 def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
@@ -300,6 +234,106 @@ def test_cli_exits_nonzero_and_annotates_on_violation(tmp_path):
     assert "title=graftlint prng-reuse" in line
 
 
+#: Seeded violations proving each rule fires through the REAL CLI, with
+#: ``--format=github`` annotations verified for the v2 concurrency rules
+#: (the CI surface the new rules ship on).
+_SEEDED_CLI_CASES = {
+    "thread-lifecycle": """
+        import threading
+
+        class Leaky:
+            def __init__(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+        """,
+    "device-probe-before-distributed-init": """
+        import jax
+        from howtotrainyourmamlpytorch_tpu.parallel import (
+            initialize_distributed,
+        )
+
+        print(jax.devices())
+        initialize_distributed()
+        """,
+    "lock-order-inversion": """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def forward(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def backward(self):
+                with self._lb:
+                    with self._la:
+                        pass
+        """,
+    "blocking-under-lock": """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """,
+    "signal-handler-unsafe": """
+        import signal
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._onterm)
+
+            def _onterm(self, signum, frame):
+                with self._lock:
+                    self.flag = True
+        """,
+    "chief-only-write": """
+        import os
+
+        class T:
+            def __init__(self, args):
+                self.process_index = int(args.process_index)
+                self._is_chief = self.process_index == 0
+
+            def publish(self, src, dst):
+                os.replace(src, dst)
+        """,
+    "exit-code-contract": """
+        import sys
+
+        sys.exit(42)
+        """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_SEEDED_CLI_CASES))
+def test_rule_registered_and_fires_through_cli(rule):
+    from tools.graftlint import RULES
+
+    assert rule in RULES
+    with tempfile.TemporaryDirectory() as tmp:
+        bad = os.path.join(tmp, "seeded.py")
+        with open(bad, "w") as f:
+            f.write(textwrap.dedent(_SEEDED_CLI_CASES[rule]))
+        proc = run_cli(bad)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert rule in proc.stdout
+        proc_gh = run_cli(bad, "--format=github")
+        assert proc_gh.returncode == 1
+        assert f"title=graftlint {rule}" in proc_gh.stdout
+
+
 def test_cli_list_rules_names_the_full_set():
     proc = run_cli("--list-rules")
     assert proc.returncode == 0
@@ -315,8 +349,44 @@ def test_cli_list_rules_names_the_full_set():
         "dead-flag",
         "device-op-in-data-path",
         "traced-mutation",
+        "thread-lifecycle",
+        "device-probe-before-distributed-init",
+        "lock-order-inversion",
+        "blocking-under-lock",
+        "signal-handler-unsafe",
+        "chief-only-write",
+        "exit-code-contract",
     } <= listed
-    assert len(listed) >= 8
+    assert len(listed) >= 15
+
+
+def test_readme_rule_table_in_sync_with_registry():
+    """The README "Static analysis & sanitizers" rule table is generated
+    from ``--list-rules`` — every registered rule id must appear in the
+    README, and the README must not name rules that no longer exist, so
+    the docs and the live registry can never drift."""
+    from tools.graftlint import RULES
+
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    start = readme.index("## Static analysis & sanitizers")
+    end = readme.find("\n## ", start + 1)
+    section = readme[start:] if end == -1 else readme[start:end]
+    for rule_id in RULES:
+        assert f"`{rule_id}`" in section, (
+            f"README rule table is missing {rule_id!r} — regenerate it "
+            "from `python -m tools.graftlint --list-rules`"
+        )
+    # Reverse direction: every first-column id in the rule table must
+    # still be a registered rule — a renamed/removed rule may not leave
+    # a stale row behind.
+    table_ids = re.findall(r"^\| `([a-z][a-z0-9-]*)` \|", section, re.M)
+    assert table_ids, "README rule table rows not found"
+    for table_id in table_ids:
+        assert table_id in RULES, (
+            f"README rule table names {table_id!r}, which is not a "
+            "registered rule — regenerate the table from --list-rules"
+        )
 
 
 def test_cli_select_filters_rules(tmp_path):
@@ -333,167 +403,3 @@ def test_cli_select_filters_rules(tmp_path):
     assert proc.returncode == 0  # the only finding is prng-reuse, filtered out
     proc_unknown = run_cli(str(bad), "--select", "bogus-rule")
     assert proc_unknown.returncode == 2
-
-
-def test_parallel_package_lints_clean_standalone():
-    """The multi-chip sharding layer (ISSUE 8) stays lint-clean as its own
-    target with ZERO suppressions: the declarative rule tables + shard/
-    gather helpers in ``parallel/`` host-numpy-interrogate leaves and issue
-    ``jax.device_put`` by design — all of it legal OUTSIDE traces and
-    OUTSIDE the data path, none of it excused by an inline suppression.
-    Also asserts the linter actually DISCOVERED the sharding modules (an
-    empty scan would vacuously pass)."""
-    parallel_dir = os.path.join(
-        REPO, "howtotrainyourmamlpytorch_tpu", "parallel"
-    )
-    assert os.path.isdir(parallel_dir)
-    proc = run_cli(parallel_dir)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the sharding layer:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-    from tools.graftlint.engine import _collect_files
-
-    scanned = _collect_files([parallel_dir])
-    names = {os.path.basename(p) for p in scanned}
-    assert {"mesh.py", "sharding.py", "distributed.py"} <= names
-    assert lint_paths([parallel_dir]) == []
-    # Zero suppressions: the layer must be clean on its own merits.
-    for path in scanned:
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
-
-
-def test_resilience_layer_lints_clean_standalone():
-    """The training-side resilience layer (ISSUE 10) stays lint-clean as
-    its own target with ZERO suppressions — and in particular passes the
-    ``thread-lifecycle`` rule it motivated: the watchdog monitor, the
-    async checkpoint writer and the prefetch stager all spawn threads AND
-    register a join path reachable from their owner's shutdown. Also
-    asserts the linter actually DISCOVERED the modules (an empty scan
-    would vacuously pass)."""
-    targets = [
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "utils",
-                     "watchdog.py"),
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "utils",
-                     "checkpoint.py"),
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "data",
-                     "device_prefetch.py"),
-        os.path.join(REPO, "tools", "chaos_train.py"),
-        os.path.join(REPO, "train_maml_system_dispatch.py"),
-    ]
-    for target in targets:
-        assert os.path.exists(target), target
-    proc = run_cli(*targets)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the resilience layer:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-
-    assert lint_paths(targets) == []
-    for path in targets:
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
-
-
-def test_thread_lifecycle_rule_is_registered_and_fires():
-    """The seeded-violation proof that the tree-wide gate actually guards
-    thread lifecycles: a retained un-joined Thread in a scratch file is a
-    ``thread-lifecycle`` violation through the REAL CLI."""
-    import tempfile
-    import textwrap
-
-    from tools.graftlint import RULES
-
-    assert "thread-lifecycle" in RULES  # id -> rule registry
-    with tempfile.TemporaryDirectory() as tmp:
-        bad = os.path.join(tmp, "leaky.py")
-        with open(bad, "w") as f:
-            f.write(textwrap.dedent(
-                """
-                import threading
-
-                class Leaky:
-                    def __init__(self):
-                        self._t = threading.Thread(target=print)
-                        self._t.start()
-                """
-            ))
-        proc = run_cli(bad)
-        assert proc.returncode == 1
-        assert "thread-lifecycle" in proc.stdout
-
-
-def test_multihost_layer_lints_clean_standalone():
-    """The pod-scale multi-host layer (ISSUE 11) stays lint-clean as its
-    own target with ZERO suppressions — and in particular the four entry
-    points plus the dispatcher/bench/chaos tools pass the
-    ``device-probe-before-distributed-init`` ordering rule they
-    motivated. Entry files live at the repo root (outside the default
-    package targets), so this pin is what keeps them scanned forever."""
-    targets = [
-        os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "parallel"),
-        os.path.join(REPO, "train_maml_system.py"),
-        os.path.join(REPO, "train_gradient_descent_system.py"),
-        os.path.join(REPO, "train_matching_nets_system.py"),
-        os.path.join(REPO, "train_maml_system_dispatch.py"),
-        os.path.join(REPO, "tools", "serve_maml.py"),
-        os.path.join(REPO, "tools", "chaos_train.py"),
-        os.path.join(REPO, "bench.py"),
-    ]
-    for target in targets:
-        assert os.path.exists(target), target
-    proc = run_cli(*targets)
-    assert proc.returncode == 0, (
-        "graftlint found violations in the multi-host layer:\n"
-        f"{proc.stdout}\n{proc.stderr}"
-    )
-    assert "graftlint: clean" in proc.stderr
-
-    from tools.graftlint import lint_paths
-    from tools.graftlint.engine import _collect_files
-
-    scanned = {os.path.basename(p) for p in _collect_files(targets)}
-    assert {
-        "distributed.py", "mesh.py", "multihost.py",
-        "train_maml_system.py", "train_maml_system_dispatch.py",
-    } <= scanned
-    assert lint_paths(targets) == []
-    for path in _collect_files(targets):
-        with open(path) as f:
-            assert "graftlint: disable" not in f.read(), path
-
-
-def test_device_probe_rule_is_registered_and_fires():
-    """Seeded-violation proof through the real CLI: a device probe before
-    ``initialize_distributed`` in a scratch entry file is a
-    ``device-probe-before-distributed-init`` violation."""
-    import tempfile
-    import textwrap
-
-    from tools.graftlint import RULES
-
-    assert "device-probe-before-distributed-init" in RULES
-    with tempfile.TemporaryDirectory() as tmp:
-        bad = os.path.join(tmp, "bad_entry.py")
-        with open(bad, "w") as f:
-            f.write(textwrap.dedent(
-                """
-                import jax
-                from howtotrainyourmamlpytorch_tpu.parallel import (
-                    initialize_distributed,
-                )
-
-                print(jax.devices())
-                initialize_distributed()
-                """
-            ))
-        proc = run_cli(bad)
-        assert proc.returncode == 1
-        assert "device-probe-before-distributed-init" in proc.stdout

@@ -1,0 +1,643 @@
+"""graftlint v2: the five whole-program concurrency/contract rules.
+
+Positive + negative units per rule (the ``tools/graftlint`` contract:
+every rule proves it fires AND proves it stays quiet on the idiom it must
+not flag), the exit-code registry pinned against the live constants, and
+the cross-validation e2e: ONE seeded lock-order inversion is caught by
+BOTH the static ``lock-order-inversion`` pass and the runtime
+``utils/locksan.py`` sanitizer executing the same source.
+"""
+
+import textwrap
+import threading
+
+from tools.graftlint import RULES, lint_source, lint_sources
+from tools.graftlint.concurrency import EXIT_CODE_REGISTRY
+
+
+def find(violations, rule):
+    return [v for v in violations if v.rule == rule]
+
+
+def test_new_rules_are_registered():
+    assert {
+        "lock-order-inversion",
+        "blocking-under-lock",
+        "signal-handler-unsafe",
+        "chief-only-write",
+        "exit-code-contract",
+    } <= set(RULES)
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion
+# ---------------------------------------------------------------------------
+
+#: The seeded deadlock shared by the static test below AND the runtime
+#: cross-validation: `forward` nests la -> lb, `backward` nests lb -> la.
+SEEDED_INVERSION_SRC = textwrap.dedent(
+    """
+    import threading
+
+
+    class Pair:
+        def __init__(self):
+            self._la = threading.Lock()
+            self._lb = threading.Lock()
+
+        def forward(self):
+            with self._la:
+                with self._lb:
+                    pass
+
+        def backward(self):
+            with self._lb:
+                with self._la:
+                    pass
+    """
+)
+
+
+def test_lock_order_inversion_fires_on_opposite_nesting():
+    hits = find(lint_source(SEEDED_INVERSION_SRC, "inv.py"),
+                "lock-order-inversion")
+    assert len(hits) == 2  # both directions of the cycle are named
+    assert any("Pair._la" in v.message and "Pair._lb" in v.message
+               for v in hits)
+
+
+def test_lock_order_inversion_quiet_on_consistent_order():
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def forward(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def also_forward(self):
+                with self._la:
+                    with self._lb:
+                        pass
+        """
+    )
+    assert find(lint_source(src, "ok.py"), "lock-order-inversion") == []
+
+
+def test_lock_order_inversion_interprocedural_and_cross_module():
+    """One half of the inversion acquires its second lock two calls deep
+    IN ANOTHER MODULE (relative-import resolution + call-graph closure)."""
+    pkg_a = textwrap.dedent(
+        """
+        import threading
+
+        from . import other
+
+        _la = threading.Lock()
+
+
+        def top():
+            with _la:
+                other.helper()
+
+
+        def regrab():
+            pass
+        """
+    )
+    pkg_b = textwrap.dedent(
+        """
+        import threading
+
+        from . import mod_a
+
+        _lb = threading.Lock()
+
+
+        def helper():
+            leaf()
+
+
+        def leaf():
+            with _lb:
+                mod_a.regrab()
+        """
+    )
+    # No cycle yet: mod_a._la -> other._lb only (regrab is lock-free).
+    violations = lint_sources({"pkg/mod_a.py": pkg_a, "pkg/other.py": pkg_b})
+    assert find(violations, "lock-order-inversion") == []
+    # Close the cycle: regrab now takes mod_a's lock while other.leaf
+    # holds its own — the opposite order, two modules apart.
+    pkg_a_cyclic = pkg_a.replace(
+        "def regrab():\n    pass",
+        "def regrab():\n    with _la:\n        pass",
+    )
+    violations = lint_sources(
+        {"pkg/mod_a.py": pkg_a_cyclic, "pkg/other.py": pkg_b}
+    )
+    hits = find(violations, "lock-order-inversion")
+    assert hits, "cross-module inversion not detected"
+    assert any("mod_a:_la" in v.message and "other:_lb" in v.message
+               for v in hits)
+
+
+def test_condition_sharing_a_lock_is_one_lock_not_a_cycle():
+    """``Condition(self._lock)`` aliases the lock (the DevicePrefetcher
+    idiom: two conditions, one mutex) — nesting them must NOT look like
+    two locks, let alone an inversion."""
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class Stager:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._not_empty = threading.Condition(self._lock)
+                self._not_full = threading.Condition(self._lock)
+
+            def pop(self):
+                with self._not_empty:
+                    self._not_full.notify()
+
+            def push(self):
+                with self._not_full:
+                    self._not_empty.notify()
+        """
+    )
+    violations = lint_source(src, "stager.py")
+    assert find(violations, "lock-order-inversion") == []
+    assert find(violations, "blocking-under-lock") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_under_lock_direct_primitives():
+    src = textwrap.dedent(
+        """
+        import threading
+        import time
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """
+    )
+    hits = find(lint_source(src, "w.py"), "blocking-under-lock")
+    assert len(hits) == 1 and "time.sleep" in hits[0].message
+
+
+def test_blocking_under_lock_reaches_through_helpers():
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def promote(self, path):
+                with self._lock:
+                    self.record(path)
+
+            def record(self, path):
+                digest(path)
+
+
+        def digest(path):
+            with open(path, "rb") as f:
+                return f.read()
+        """
+    )
+    hits = find(lint_source(src, "pool.py"), "blocking-under-lock")
+    assert hits, "interprocedural blocking call not reached"
+    assert "file open" in hits[0].message
+    assert "self.record" in hits[0].message
+
+
+def test_blocking_queue_get_under_lock_flags_nonblocking_does_not():
+    src = textwrap.dedent(
+        """
+        import queue
+        import threading
+
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    return self._q.get()
+
+            def fine(self):
+                with self._lock:
+                    return self._q.get(block=False)
+        """
+    )
+    hits = find(lint_source(src, "q.py"), "blocking-under-lock")
+    assert len(hits) == 1
+    assert hits[0].line < 15  # only the blocking get
+
+
+def test_own_condition_wait_is_not_blocking_foreign_wait_is():
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._other = threading.Condition()
+
+            def good(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def bad(self):
+                with self._cond:
+                    self._other.wait(0.1)
+        """
+    )
+    hits = find(lint_source(src, "c.py"), "blocking-under-lock")
+    assert len(hits) == 1 and "DIFFERENT lock" in hits[0].message
+
+
+def test_dispatch_outside_lock_is_quiet():
+    """The batcher idiom — pop the group under the lock, dispatch outside
+    — must stay clean."""
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class Engine:
+            def dispatch(self, group):
+                return group
+
+
+        class Batcher:
+            def __init__(self):
+                self._lock = threading.Condition()
+                self.engine = Engine()
+                self._groups = []
+
+            def run_once(self):
+                with self._lock:
+                    ready = list(self._groups)
+                    self._groups.clear()
+                for group in ready:
+                    self.engine.dispatch(group)
+        """
+    )
+    assert find(lint_source(src, "b.py"), "blocking-under-lock") == []
+
+
+def test_dispatch_under_lock_is_flagged():
+    src = textwrap.dedent(
+        """
+        import threading
+
+
+        class Engine:
+            def dispatch(self, group):
+                return group
+
+
+        class Batcher:
+            def __init__(self):
+                self._lock = threading.Condition()
+                self.engine = Engine()
+
+            def run_once(self, group):
+                with self._lock:
+                    return self.engine.dispatch(group)
+        """
+    )
+    hits = find(lint_source(src, "b.py"), "blocking-under-lock")
+    assert hits and "dispatch" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# signal-handler-unsafe
+# ---------------------------------------------------------------------------
+
+
+def test_signal_handler_lock_flagged():
+    src = textwrap.dedent(
+        """
+        import signal
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._onterm)
+
+            def _onterm(self, signum, frame):
+                with self._lock:
+                    self.flag = True
+        """
+    )
+    hits = find(lint_source(src, "s.py"), "signal-handler-unsafe")
+    assert hits and "deadlock" in hits[0].message
+
+
+def test_signal_handler_print_flagged_flag_set_quiet():
+    src = textwrap.dedent(
+        """
+        import os
+        import signal
+
+
+        def install(state):
+            def handler(signum, frame):
+                state.flag = signum
+                print("caught", signum)
+
+            signal.signal(signal.SIGTERM, handler)
+
+
+        def install_safe(state):
+            def handler(signum, frame):
+                state.flag = signum
+                os.write(2, b"caught\\n")
+                raise KeyboardInterrupt
+
+            signal.signal(signal.SIGINT, handler)
+        """
+    )
+    hits = find(lint_source(src, "h.py"), "signal-handler-unsafe")
+    assert len(hits) == 1 and "print()" in hits[0].message
+
+
+def test_signal_handler_sanctioned_idioms_quiet():
+    """The tree's real handler shapes: Event.set (promotion daemon),
+    defer-to-thread (serve front door), a resolvable flag-setting method
+    call one level deep (telemetry SIGUSR1 lambda)."""
+    src = textwrap.dedent(
+        """
+        import signal
+        import threading
+
+
+        class Profiler:
+            def request(self, reason):
+                self._pending = reason
+
+
+        class T:
+            def __init__(self, server):
+                self.profiler = Profiler()
+                self.stop = threading.Event()
+                self.server = server
+                signal.signal(
+                    signal.SIGUSR1,
+                    lambda s, f: self.profiler.request("signal"),
+                )
+                signal.signal(signal.SIGTERM, self._graceful)
+                signal.signal(signal.SIGINT, self._defer)
+
+            def _graceful(self, signum, frame):
+                self.stop.set()
+
+            def _defer(self, signum, frame):
+                threading.Thread(
+                    target=self.server.shutdown, daemon=True
+                ).start()
+        """
+    )
+    assert find(lint_source(src, "t.py"), "signal-handler-unsafe") == []
+
+
+def test_signal_handler_blocking_call_one_level_deep_flagged():
+    src = textwrap.dedent(
+        """
+        import signal
+        import time
+
+
+        def drain():
+            time.sleep(5.0)
+
+
+        def handler(signum, frame):
+            drain()
+
+
+        signal.signal(signal.SIGTERM, handler)
+        """
+    )
+    hits = find(lint_source(src, "d.py"), "signal-handler-unsafe")
+    assert hits and "unsafe work" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# chief-only-write
+# ---------------------------------------------------------------------------
+
+_CHIEF_PREFIX = textwrap.dedent(
+    """
+    import os
+
+
+    class Trainer:
+        def __init__(self, args):
+            self.process_index = int(args.process_index)
+            self._is_chief = self.process_index == 0
+    """
+)
+
+
+def test_chief_only_write_flags_unguarded_mutation():
+    src = _CHIEF_PREFIX + textwrap.dedent(
+        """
+        def publish(self, src, dst):
+            os.replace(src, dst)
+    """
+    ).replace("\n", "\n    ")
+    hits = find(lint_source(src, "t.py"), "chief-only-write")
+    assert hits and "os.replace" in hits[0].message
+
+
+def test_chief_only_write_quiet_under_guard_and_early_return():
+    src = _CHIEF_PREFIX + textwrap.dedent(
+        """
+        def publish(self, src, dst):
+            if self._is_chief:
+                os.replace(src, dst)
+
+        def save(self, src, dst):
+            self.t0 = 0.0
+            if not self._is_chief:
+                self.t0 = 1.0
+                return
+            os.replace(src, dst)
+
+        def epoch(self, src, dst):
+            self.save(src, dst)
+    """
+    ).replace("\n", "\n    ")
+    assert find(lint_source(src, "t.py"), "chief-only-write") == []
+
+
+def test_chief_only_write_out_of_scope_without_election():
+    """A module that never elects a chief (single-process serving, the
+    telemetry heartbeat's per-rank files) is out of scope entirely."""
+    src = textwrap.dedent(
+        """
+        import os
+
+
+        def publish(src, dst):
+            os.replace(src, dst)
+        """
+    )
+    assert find(lint_source(src, "p.py"), "chief-only-write") == []
+
+
+# ---------------------------------------------------------------------------
+# exit-code-contract
+# ---------------------------------------------------------------------------
+
+
+def test_exit_code_registry_matches_live_constants():
+    """The registry and the real constants can never diverge — this is
+    the declared single source the rule enforces against."""
+    from howtotrainyourmamlpytorch_tpu.experiment_builder import (
+        REQUEUE_EXIT_CODE,
+    )
+    from howtotrainyourmamlpytorch_tpu.serve.api import REPLICA_KILL_EXIT
+    from howtotrainyourmamlpytorch_tpu.utils.watchdog import HANG_EXIT_CODE
+
+    assert REQUEUE_EXIT_CODE in EXIT_CODE_REGISTRY
+    assert HANG_EXIT_CODE in EXIT_CODE_REGISTRY
+    assert REPLICA_KILL_EXIT in EXIT_CODE_REGISTRY
+    assert EXIT_CODE_REGISTRY[75].startswith("preemption")
+    assert "hang" in EXIT_CODE_REGISTRY[76]
+    assert 3 in EXIT_CODE_REGISTRY  # the miner's no-yield exit
+
+
+def test_exit_code_contract_flags_undeclared_literal():
+    src = "import sys\n\nsys.exit(42)\n"
+    hits = find(lint_source(src, "x.py"), "exit-code-contract")
+    assert hits and "42" in hits[0].message
+
+
+def test_exit_code_contract_quiet_on_declared_and_symbolic():
+    src = textwrap.dedent(
+        """
+        import os
+        import sys
+
+        HANG = 76
+
+
+        def a():
+            sys.exit(75)
+
+
+        def b():
+            os._exit(HANG)
+
+
+        def c(rc):
+            sys.exit(rc)
+        """
+    )
+    assert find(lint_source(src, "x.py"), "exit-code-contract") == []
+
+
+def test_exit_code_contract_bare_except():
+    src = textwrap.dedent(
+        """
+        def swallow():
+            try:
+                risky()
+            except:
+                pass
+
+
+        def reraise():
+            try:
+                risky()
+            except:
+                cleanup()
+                raise
+        """
+    )
+    hits = find(lint_source(src, "x.py"), "exit-code-contract")
+    assert len(hits) == 1 and "bare" in hits[0].message
+    assert hits[0].line == 5
+
+
+# ---------------------------------------------------------------------------
+# Cross-validation: the SAME seeded deadlock, static AND runtime
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_inversion_caught_by_static_and_runtime(locksan):
+    """The e2e contract of graftlint v2: one seeded AB/BA inversion, the
+    static rule flags the source, and executing that same source under
+    the locksan sanitizer records the cycle at runtime."""
+    # Static half.
+    static_hits = find(
+        lint_source(SEEDED_INVERSION_SRC, "seeded.py"),
+        "lock-order-inversion",
+    )
+    assert len(static_hits) == 2
+
+    # Runtime half: execute the very same source under the sanitizer.
+    with locksan() as san:
+        namespace: dict = {}
+        exec(compile(SEEDED_INVERSION_SRC, "seeded.py", "exec"), namespace)
+        pair = namespace["Pair"]()
+        t1 = threading.Thread(target=pair.forward)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=pair.backward)
+        t2.start()
+        t2.join()
+    cycles = san.cycles()
+    assert cycles, "runtime sanitizer missed the seeded inversion"
+    assert any(
+        all("seeded.py" in site for site in component)
+        for component in cycles
+    )
+    try:
+        san.assert_clean()
+    except AssertionError as exc:
+        assert "cyclic lock-acquisition order" in str(exc)
+    else:
+        raise AssertionError("assert_clean did not fail on the cycle")
+
+
+def test_locksan_quiet_on_consistent_order(locksan):
+    with locksan() as san:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    assert san.cycles() == []
+    san.assert_clean(hold_budget_s=5.0)

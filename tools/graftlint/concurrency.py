@@ -1,0 +1,1286 @@
+"""Whole-program concurrency & distributed-contract analysis (graftlint v2).
+
+PRs 6-13 grew ~10k LoC of concurrent host-side control plane (replica-pool
+supervisors, batcher workers, prefetch stagers, the async checkpoint
+writer, watchdog monitors, promotion daemon threads), and every review
+pass kept hand-finding the same failure classes: work done under a lock
+that didn't need it, blocking calls inside critical sections, signal
+handlers doing non-reentrant work, two ranks racing one tmp+rename, exit
+codes invented ad hoc. This module makes those classes mechanical, the
+way ``tracing.py`` already does for the JAX hot path:
+
+* :class:`ConcurrencyAnalysis` builds one PROJECT-wide model per lint run
+  (cached on the :class:`~tools.graftlint.core.Project`): which class /
+  module attributes are ``threading.Lock``/``RLock``/``Condition`` objects
+  (``Condition(self._lock)`` aliases the shared lock, so the prefetcher's
+  two conditions are ONE lock, not three), which are queues/events, which
+  ``self.x = SomeClass(...)`` attributes carry a project class (one-level
+  type inference for ``self.engine.dispatch(...)``-style resolution), and
+  a cross-module call graph covering relative imports (``from ..telemetry
+  import events``) that :func:`~tools.graftlint.tracing.build_alias_map`
+  deliberately skips.
+
+* Every function is walked once with a held-lock stack: direct nested
+  acquisitions yield lock-ORDER edges, call sites made with locks held
+  are closed transitively over the call graph (bounded depth) so a lock
+  acquired three helpers deep still produces its edge, and blocking
+  primitives reachable with a lock held are reported at the call site
+  that holds the lock.
+
+The five rules riding the model are registered in ``rules.ALL_RULES``:
+``lock-order-inversion``, ``blocking-under-lock``,
+``signal-handler-unsafe``, ``chief-only-write`` and
+``exit-code-contract``. The runtime twin is
+``howtotrainyourmamlpytorch_tpu/utils/locksan.py`` — the instrumented-lock
+sanitizer that records the ACTUAL acquisition-order graph during the
+serve/chaos suites and is cross-validated against the static pass on the
+same seeded deadlock (``tests/test_graftlint_concurrency.py``).
+
+Everything here is heuristic by design (the tracing.py tradeoff):
+zero-dependency, zero-execution, false-positive-averse first — tier-1
+enforces a clean tree, so a noisy rule would be worse than no rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from .core import ModuleFile, Project
+from .tracing import resolve_dotted
+
+#: Bounded interprocedural closure depth — deep enough for the real
+#: chains in this tree (pool.promote -> checkpoint_digest -> open), small
+#: enough that a pathological call graph cannot blow the lint run up.
+MAX_CALL_DEPTH = 6
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+_CONDITION_CTOR = "threading.Condition"
+_QUEUE_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+}
+_EVENT_CTOR = "threading.Event"
+
+#: Calls that block the calling thread, by fully-resolved dotted path.
+#: Keyed to the classes this codebase actually contains (HTTP scrapes,
+#: subprocess waits, file hashing/copies, fsync, device syncs).
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync (durable-write barrier)",
+    "urllib.request.urlopen": "HTTP request (urllib.request.urlopen)",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "shutil.copyfile": "file copy (shutil.copyfile)",
+    "shutil.copy": "file copy (shutil.copy)",
+    "shutil.copytree": "tree copy (shutil.copytree)",
+    "shutil.rmtree": "tree delete (shutil.rmtree)",
+    "socket.create_connection": "socket connect",
+    "requests.get": "HTTP request (requests.get)",
+    "requests.post": "HTTP request (requests.post)",
+    "jax.block_until_ready": "device sync (jax.block_until_ready)",
+    "jax.device_get": "device fetch (jax.device_get)",
+    "open": "file open for I/O",
+}
+
+#: Attribute-call tails that block regardless of receiver resolution.
+#: ``communicate``/``wait_output`` only ever mean Popen here; ``join`` is
+#: filtered through the same non-thread heuristics as thread-lifecycle.
+_BLOCKING_TAILS = {
+    "communicate": "subprocess communicate",
+}
+
+#: Method tails that dispatch jitted device programs in this codebase —
+#: "jitted-step dispatch" from the issue: holding a host lock across a
+#: device dispatch serializes every other thread behind device time.
+_DISPATCH_TAILS = {"dispatch", "run_train_iter", "run_train_iters"}
+
+#: Exit codes this repo has DECLARED (README "Fault tolerance" matrix is
+#: regenerated from here; ``tests/test_graftlint_concurrency.py`` pins the
+#: registry against the live constants so the two can never diverge).
+#: Any other integer literal in ``sys.exit``/``os._exit``/``SystemExit``
+#: is an undeclared exit code — name it here (with a meaning) or use a
+#: declared constant.
+EXIT_CODE_REGISTRY = {
+    0: "success",
+    1: "failure (generic; graftlint CLI findings)",
+    2: "usage error (argparse; loadtest SLO FAIL)",
+    3: "episode miner: nothing cleared the margin gate (no manifest)",
+    75: "preemption requeue (EX_TEMPFAIL; resume on the same mesh)",
+    76: "watchdog hang — requeue degraded (suspect the topology)",
+    86: "serve replica fault-kill (injected worker death)",
+}
+
+
+# ---------------------------------------------------------------------------
+# Import / call-target resolution (absolute + relative)
+# ---------------------------------------------------------------------------
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+@dataclass
+class _ClassInfo:
+    module: "ModuleFile"
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)  # name -> FunctionDef
+    lock_attrs: dict = field(default_factory=dict)  # attr -> lock id
+    queue_attrs: set = field(default_factory=set)
+    event_attrs: set = field(default_factory=set)
+    #: attr -> (module_path, class_name): one-level type inference from
+    #: ``self.attr = SomeProjectClass(...)`` assignments.
+    obj_attrs: dict = field(default_factory=dict)
+
+
+@dataclass
+class _FuncEntry:
+    key: tuple  # (module_path, class_name|None, func_name)
+    module: "ModuleFile"
+    cls: _ClassInfo | None
+    node: ast.AST
+    #: lock ids acquired directly in this function.
+    acquires: set = field(default_factory=set)
+    #: (held_lock_id, acquired_lock_id, site_node) for direct nesting.
+    edges: list = field(default_factory=list)
+    #: (held frozenset, call node, resolved target key | None, label)
+    calls: list = field(default_factory=list)
+    #: (node, description, held frozenset) blocking primitives hit
+    #: directly in this function (held may be empty — callers holding a
+    #: lock still make them findings at the call site).
+    blocking: list = field(default_factory=list)
+
+
+class ConcurrencyAnalysis:
+    """One project-wide pass shared by the concurrency/contract rules."""
+
+    @classmethod
+    def of(cls, project: Project) -> "ConcurrencyAnalysis":
+        cached = getattr(project, "_concurrency_analysis", None)
+        if cached is None:
+            cached = cls(project)
+            project._concurrency_analysis = cached
+        return cached
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.modules = {m.path: m for m in project.modules}
+        self._module_by_relpath: dict[str, ModuleFile] = {}
+        for m in project.modules:
+            self._module_by_relpath[_norm(m.path)] = m
+        #: local name -> ("module"|"func"|"class", ModuleFile, name|None)
+        self.imports: dict[str, dict] = {}
+        self.classes: dict[tuple, _ClassInfo] = {}  # (path, name)
+        self.module_locks: dict[str, dict] = {}  # path -> {name: lock id}
+        self.funcs: dict[tuple, _FuncEntry] = {}
+        self._acq_memo: dict[tuple, frozenset] = {}
+        self._block_memo: dict[tuple, dict] = {}
+
+        for m in project.modules:
+            self.imports[m.path] = self._bind_imports(m)
+        for m in project.modules:
+            self._collect_classes(m)
+        for m in project.modules:
+            self._collect_module_locks(m)
+        for m in project.modules:
+            self._walk_functions(m)
+        self._global_edges: list[dict] | None = None
+
+    # -- imports --------------------------------------------------------
+
+    def _find_module(self, dotted_or_parts: str) -> ModuleFile | None:
+        """Project module for a dotted path, by path-suffix match."""
+        rel = dotted_or_parts.replace(".", "/")
+        for suffix in (f"{rel}.py", f"{rel}/__init__.py"):
+            for path, module in self._module_by_relpath.items():
+                if path == suffix or path.endswith("/" + suffix):
+                    return module
+        return None
+
+    def _bind_imports(self, module: ModuleFile) -> dict:
+        """Maps this module's local names to project targets, covering the
+        relative imports ``build_alias_map`` skips."""
+        binds: dict[str, dict] = {}
+        base_dir = _norm(module.path).rsplit("/", 1)[0] if "/" in _norm(
+            module.path
+        ) else ""
+
+        def bind_name(local: str, target: ModuleFile | None, attr: str | None):
+            if target is None:
+                return
+            if attr is None:
+                binds[local] = {"kind": "module", "module": target}
+                return
+            kind = None
+            for node in target.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node.name == attr:
+                        kind = "func"
+                elif isinstance(node, ast.ClassDef) and node.name == attr:
+                    kind = "class"
+            if kind:
+                binds[local] = {"kind": kind, "module": target, "name": attr}
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    target = self._find_module(a.name)
+                    if target is not None:
+                        binds[a.asname or a.name.split(".")[0]] = {
+                            "kind": "module", "module": target,
+                        }
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    src = node.module or ""
+                    src_mod = self._find_module(src) if src else None
+                    for a in node.names:
+                        sub = (
+                            self._find_module(f"{src}.{a.name}") if src else None
+                        )
+                        if sub is not None:
+                            bind_name(a.asname or a.name, sub, None)
+                        elif src_mod is not None:
+                            bind_name(a.asname or a.name, src_mod, a.name)
+                else:
+                    parts = base_dir.split("/") if base_dir else []
+                    up = node.level - 1
+                    anchor = parts[: len(parts) - up] if up else parts
+                    prefix = "/".join(
+                        anchor + (node.module or "").split(".")
+                    ).strip("/")
+                    src_mod = self._find_module(prefix.replace("/", "."))
+                    for a in node.names:
+                        sub = self._find_module(
+                            f"{prefix}/{a.name}".replace("/", ".")
+                        )
+                        if sub is not None:
+                            bind_name(a.asname or a.name, sub, None)
+                        elif src_mod is not None:
+                            bind_name(a.asname or a.name, src_mod, a.name)
+        return binds
+
+    # -- class / lock discovery ----------------------------------------
+
+    @staticmethod
+    def _module_base(module: ModuleFile) -> str:
+        name = _norm(module.path).rsplit("/", 1)[-1]
+        return name[:-3] if name.endswith(".py") else name
+
+    def _ctor_path(self, call: ast.Call, module: ModuleFile) -> str | None:
+        return resolve_dotted(call.func, module.aliases)
+
+    def _collect_classes(self, module: ModuleFile) -> None:
+        base = self._module_base(module)
+        for node in module.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassInfo(module=module, node=node)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.methods[item.name] = item
+            # Attribute classification, in source order so a Condition
+            # sharing an earlier lock aliases it.
+            for meth in info.methods.values():
+                for stmt in ast.walk(meth):
+                    if not isinstance(stmt, ast.Assign) or not isinstance(
+                        stmt.value, ast.Call
+                    ):
+                        continue
+                    target = stmt.targets[0]
+                    if not (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        continue
+                    attr = target.attr
+                    ctor = self._ctor_path(stmt.value, module)
+                    if ctor in _LOCK_CTORS:
+                        info.lock_attrs[attr] = f"{base}:{node.name}.{attr}"
+                    elif ctor == _CONDITION_CTOR:
+                        shared = None
+                        if stmt.value.args:
+                            arg = stmt.value.args[0]
+                            if (
+                                isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"
+                            ):
+                                shared = info.lock_attrs.get(arg.attr)
+                        info.lock_attrs[attr] = (
+                            shared or f"{base}:{node.name}.{attr}"
+                        )
+                    elif ctor in _QUEUE_CTORS:
+                        info.queue_attrs.add(attr)
+                    elif ctor == _EVENT_CTOR:
+                        info.event_attrs.add(attr)
+                    elif ctor is not None:
+                        resolved = self._resolve_class_ctor(ctor, module)
+                        if resolved is not None:
+                            info.obj_attrs[attr] = resolved
+            self.classes[(module.path, node.name)] = info
+
+    def _resolve_class_ctor(
+        self, ctor: str, module: ModuleFile
+    ) -> tuple | None:
+        """``SomeClass`` / ``alias.SomeClass`` -> (module_path, class)."""
+        head, _, tail = ctor.partition(".")
+        binds = self.imports.get(module.path, {})
+        if not tail:
+            if (module.path, head) in self.classes or any(
+                isinstance(n, ast.ClassDef) and n.name == head
+                for n in module.tree.body
+            ):
+                return (module.path, head)
+            bound = binds.get(head)
+            if bound and bound["kind"] == "class":
+                return (bound["module"].path, bound["name"])
+            return None
+        bound = binds.get(head)
+        if bound and bound["kind"] == "module" and "." not in tail:
+            target = bound["module"]
+            if any(
+                isinstance(n, ast.ClassDef) and n.name == tail
+                for n in target.tree.body
+            ):
+                return (target.path, tail)
+        return None
+
+    def _collect_module_locks(self, module: ModuleFile) -> None:
+        base = self._module_base(module)
+        locks: dict[str, str] = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                target = node.targets[0]
+                if isinstance(target, ast.Name):
+                    ctor = self._ctor_path(node.value, module)
+                    if ctor in _LOCK_CTORS or ctor == _CONDITION_CTOR:
+                        locks[target.id] = f"{base}:{target.id}"
+        self.module_locks[module.path] = locks
+
+    # -- lock expression resolution ------------------------------------
+
+    def _lock_id_of(
+        self, expr: ast.AST, module: ModuleFile, cls: _ClassInfo | None
+    ) -> str | None:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            return cls.lock_attrs.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(module.path, {}).get(expr.id)
+        return None
+
+    # -- call-target resolution ----------------------------------------
+
+    def resolve_call(
+        self, call: ast.Call, module: ModuleFile, cls: _ClassInfo | None
+    ) -> tuple | None:
+        """Call -> function key ``(module_path, class|None, name)`` when
+        the target is resolvable inside the scanned project."""
+        func = call.func
+        binds = self.imports.get(module.path, {})
+        if isinstance(func, ast.Name):
+            bound = binds.get(func.id)
+            if bound is not None:
+                if bound["kind"] == "func":
+                    return (bound["module"].path, None, bound["name"])
+                if bound["kind"] == "class":
+                    return (bound["module"].path, bound["name"], "__init__")
+            for node in module.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node.name == func.id:
+                        return (module.path, None, func.id)
+                elif isinstance(node, ast.ClassDef) and node.name == func.id:
+                    return (module.path, func.id, "__init__")
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        owner = func.value
+        # self.m(...)
+        if isinstance(owner, ast.Name) and owner.id == "self" and cls is not None:
+            if func.attr in cls.methods:
+                return (cls.module.path, cls.node.name, func.attr)
+            return None
+        # alias.m(...) where alias is a project module
+        if isinstance(owner, ast.Name):
+            bound = binds.get(owner.id)
+            if bound is not None and bound["kind"] == "module":
+                target = bound["module"]
+                for node in target.tree.body:
+                    if isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and node.name == func.attr:
+                        return (target.path, None, func.attr)
+                    if (
+                        isinstance(node, ast.ClassDef)
+                        and node.name == func.attr
+                    ):
+                        return (target.path, func.attr, "__init__")
+            return None
+        # self.obj.m(...) via one-level attribute typing
+        if (
+            isinstance(owner, ast.Attribute)
+            and isinstance(owner.value, ast.Name)
+            and owner.value.id == "self"
+            and cls is not None
+        ):
+            typed = cls.obj_attrs.get(owner.attr)
+            if typed is not None:
+                target_cls = self.classes.get(typed)
+                if target_cls is not None and func.attr in target_cls.methods:
+                    return (typed[0], typed[1], func.attr)
+        return None
+
+    # -- blocking-primitive classification -----------------------------
+
+    def _blocking_desc(
+        self, call: ast.Call, module: ModuleFile, cls: _ClassInfo | None,
+        held: frozenset,
+    ) -> str | None:
+        resolved = resolve_dotted(call.func, module.aliases)
+        if resolved in BLOCKING_CALLS:
+            if resolved == "open" and not _opens_for_real(call):
+                return None
+            return BLOCKING_CALLS[resolved]
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        tail = func.attr
+        if tail in _BLOCKING_TAILS:
+            return _BLOCKING_TAILS[tail]
+        owner = func.value
+        owner_attr = (
+            owner.attr
+            if isinstance(owner, ast.Attribute)
+            and isinstance(owner.value, ast.Name)
+            and owner.value.id == "self"
+            else None
+        )
+        # Blocking queue get/put on a tracked queue attribute (unless
+        # explicitly non-blocking).
+        if tail in ("get", "put") and cls is not None and owner_attr is not None:
+            if owner_attr in cls.queue_attrs and not _nonblocking_kwargs(call):
+                return f"blocking queue.{tail} on self.{owner_attr}"
+        # Future.result: only when the receiver visibly smells like one.
+        if tail == "result":
+            base = owner_attr or (owner.id if isinstance(owner, ast.Name) else "")
+            if base and ("future" in base.lower() or base.lower().startswith("fut")):
+                return f"Future.result on {base!r}"
+        # Condition/Event wait: waiting on the HELD condition releases it
+        # (that is what conditions are for); waiting on anything else
+        # while a lock is held parks the lock across the wait.
+        if tail in ("wait", "wait_for"):
+            lock_id = self._lock_id_of(owner, module, cls)
+            if lock_id is not None:
+                return (
+                    None if lock_id in held
+                    else f"Condition.{tail} on a DIFFERENT lock ({lock_id})"
+                )
+            if cls is not None and owner_attr in cls.event_attrs:
+                return f"Event.wait on self.{owner_attr}"
+            return None
+        if tail in _DISPATCH_TAILS:
+            target = self.resolve_call(call, module, cls)
+            if target is not None or tail == "dispatch":
+                return f"jitted-step dispatch ({tail})"
+        if tail == "join" and _is_thread_join_like(call, module):
+            return "thread join"
+        return None
+
+    # -- per-function walk ---------------------------------------------
+
+    def _walk_functions(self, module: ModuleFile) -> None:
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_one(module, None, node)
+            elif isinstance(node, ast.ClassDef):
+                info = self.classes[(module.path, node.name)]
+                for meth in info.methods.values():
+                    self._walk_one(module, info, meth)
+
+    def _walk_one(
+        self, module: ModuleFile, cls: _ClassInfo | None, fn: ast.AST
+    ) -> None:
+        key = (module.path, cls.node.name if cls else None, fn.name)
+        entry = _FuncEntry(key=key, module=module, cls=cls, node=fn)
+        self.funcs[key] = entry
+        self._walk_stmts(list(fn.body), (), entry)
+
+    def _walk_stmts(self, stmts: list, held: tuple, entry: _FuncEntry) -> None:
+        held_list = list(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope — its own walk would lose `self`
+            if isinstance(stmt, ast.With):
+                new = []
+                for item in stmt.items:
+                    lock_id = self._lock_id_of(
+                        item.context_expr, entry.module, entry.cls
+                    )
+                    if lock_id is not None:
+                        entry.acquires.add(lock_id)
+                        for h in held_list + new:
+                            if h != lock_id:
+                                entry.edges.append((h, lock_id, stmt))
+                        new.append(lock_id)
+                    else:
+                        self._scan_exprs(item.context_expr, held_list, entry)
+                self._walk_stmts(
+                    stmt.body, tuple(held_list + new), entry
+                )
+                continue
+            # Explicit acquire()/release() on a tracked lock.
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and call.func.attr in (
+                    "acquire", "release",
+                ):
+                    lock_id = self._lock_id_of(
+                        call.func.value, entry.module, entry.cls
+                    )
+                    if lock_id is not None:
+                        if call.func.attr == "acquire":
+                            entry.acquires.add(lock_id)
+                            for h in held_list:
+                                if h != lock_id:
+                                    entry.edges.append((h, lock_id, stmt))
+                            held_list.append(lock_id)
+                        elif lock_id in held_list:
+                            held_list.remove(lock_id)
+                        continue
+            for child_body in _stmt_bodies(stmt):
+                self._walk_stmts(child_body, tuple(held_list), entry)
+            for expr in _stmt_exprs(stmt):
+                self._scan_exprs(expr, held_list, entry)
+
+    def _scan_exprs(self, expr: ast.AST, held_list: list, entry: _FuncEntry):
+        held = frozenset(held_list)
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            desc = self._blocking_desc(node, entry.module, entry.cls, held)
+            if desc is not None:
+                entry.blocking.append((node, desc, held))
+                continue
+            target = self.resolve_call(node, entry.module, entry.cls)
+            if target is not None and target != entry.key:
+                label = _call_label(node)
+                entry.calls.append((held, node, target, label))
+
+    # -- transitive summaries ------------------------------------------
+
+    def acq_closure(self, key: tuple, _depth: int = 0, _stack=None) -> frozenset:
+        """Locks a function may acquire, including via project callees."""
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        entry = self.funcs.get(key)
+        if entry is None:
+            return frozenset()
+        stack = _stack or set()
+        if key in stack or _depth > MAX_CALL_DEPTH:
+            return frozenset(entry.acquires)
+        stack = stack | {key}
+        out = set(entry.acquires)
+        for _held, _node, target, _label in entry.calls:
+            if target is not None:
+                out |= self.acq_closure(target, _depth + 1, stack)
+        result = frozenset(out)
+        if _depth == 0:
+            self._acq_memo[key] = result
+        return result
+
+    def block_closure(self, key: tuple, _depth: int = 0, _stack=None) -> dict:
+        """Blocking primitives reachable from a function: desc -> chain."""
+        if key in self._block_memo:
+            return self._block_memo[key]
+        entry = self.funcs.get(key)
+        if entry is None:
+            return {}
+        stack = _stack or set()
+        if key in stack or _depth > MAX_CALL_DEPTH:
+            return {}
+        stack = stack | {key}
+        out: dict[str, str] = {}
+        for _node, desc, _held in entry.blocking:
+            out.setdefault(desc, _key_label(key))
+        for _held, _node, target, label in entry.calls:
+            if target is None:
+                continue
+            for desc, chain in self.block_closure(
+                target, _depth + 1, stack
+            ).items():
+                out.setdefault(desc, f"{_key_label(key)} -> {chain}")
+        if _depth == 0:
+            self._block_memo[key] = out
+        return out
+
+    # -- the global lock-order graph -----------------------------------
+
+    def lock_order_edges(self) -> list[dict]:
+        """Every (held -> acquired) edge in the project: direct nestings
+        plus lock-held call sites closed over the callee's acquisition
+        set. Each edge remembers its site for reporting/suppression."""
+        if self._global_edges is not None:
+            return self._global_edges
+        edges: list[dict] = []
+        for key, entry in self.funcs.items():
+            for held_id, acq_id, node in entry.edges:
+                edges.append({
+                    "src": held_id, "dst": acq_id,
+                    "module": entry.module, "node": node,
+                    "via": f"direct nesting in {_key_label(key)}",
+                })
+            for held, node, target, label in entry.calls:
+                if not held or target is None:
+                    continue
+                for acq_id in self.acq_closure(target):
+                    for held_id in held:
+                        if held_id != acq_id:
+                            edges.append({
+                                "src": held_id, "dst": acq_id,
+                                "module": entry.module, "node": node,
+                                "via": (
+                                    f"call to {label} (which acquires "
+                                    f"{acq_id}) in {_key_label(key)}"
+                                ),
+                            })
+        self._global_edges = edges
+        return edges
+
+    def lock_order_cycles(self) -> tuple[set, list[dict]]:
+        """(set of lock-ids inside some cycle, the edges between them).
+        Tarjan SCC (components of size >= 2 are cyclic orders) shared
+        with the runtime sanitizer via ``utils/algo.tarjan_scc`` — the
+        package ``__init__`` is import-free, so graftlint stays
+        importable without jax."""
+        from howtotrainyourmamlpytorch_tpu.utils.algo import tarjan_scc
+
+        edges = self.lock_order_edges()
+        adj: dict[str, set] = {}
+        for e in edges:
+            adj.setdefault(e["src"], set()).add(e["dst"])
+        cyclic: set[str] = set()
+        for component in tarjan_scc(adj):
+            cyclic.update(component)
+        cycle_edges = [
+            e for e in edges if e["src"] in cyclic and e["dst"] in cyclic
+        ]
+        return cyclic, cycle_edges
+
+
+# -- small AST helpers ------------------------------------------------------
+
+
+def _stmt_bodies(stmt: ast.stmt) -> Iterator[list]:
+    for attr in ("body", "orelse", "finalbody"):
+        body = getattr(stmt, attr, None)
+        if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+            yield body
+    for handler in getattr(stmt, "handlers", []) or []:
+        yield handler.body
+
+
+def _stmt_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Expression children of a statement, excluding nested statement
+    bodies (those are walked with their own held-stack)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            yield value
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, ast.AST) and not isinstance(item, ast.stmt):
+                    yield item
+
+
+def _nonblocking_kwargs(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant):
+            if kw.value.value is False:
+                return True
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant):
+            if kw.value.value == 0:
+                return True
+    return False
+
+
+def _opens_for_real(call: ast.Call) -> bool:
+    """``open`` blocks on real I/O either way; reading tiny configs under
+    a lock is still a finding, so every ``open`` counts."""
+    return True
+
+
+def _is_thread_join_like(call: ast.Call, module: ModuleFile) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if isinstance(func.value, ast.Constant):
+        return False  # ", ".join(...)
+    resolved = resolve_dotted(func, module.aliases) or ""
+    return not resolved.startswith(
+        ("os.path.", "posixpath.", "ntpath.", "str.")
+    )
+
+
+def _call_label(call: ast.Call) -> str:
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return "<call>"
+
+
+def _key_label(key: tuple) -> str:
+    path, cls, name = key
+    base = _norm(path).rsplit("/", 1)[-1]
+    return f"{base}:{cls}.{name}" if cls else f"{base}:{name}"
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+from .rules import Rule  # noqa: E402  (cycle-free: rules imports nothing back)
+
+
+class LockOrderInversionRule(Rule):
+    id = "lock-order-inversion"
+    summary = (
+        "two locks are acquired in opposite orders on different code "
+        "paths (interprocedural, project-wide) — a potential deadlock the "
+        "chaos harness can only ever catch probabilistically"
+    )
+
+    def check(self, module, project):
+        analysis = ConcurrencyAnalysis.of(project)
+        _cyclic, cycle_edges = analysis.lock_order_cycles()
+        seen: set[tuple] = set()
+        for edge in cycle_edges:
+            if edge["module"] is not module:
+                continue
+            pos = (edge["node"].lineno, edge["src"], edge["dst"])
+            if pos in seen:
+                continue
+            seen.add(pos)
+            yield self._v(
+                module,
+                edge["node"],
+                f"acquiring {edge['dst']!r} while holding {edge['src']!r} "
+                f"({edge['via']}) participates in a cyclic lock order — "
+                "another path acquires these locks in the opposite order; "
+                "pick one global order or narrow one critical section",
+            )
+
+
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    summary = (
+        "a blocking call (queue get/put, Future.result, HTTP, subprocess, "
+        "fsync, file I/O, sleep, foreign Condition.wait, jitted dispatch) "
+        "runs or is reachable while a threading lock is held — every "
+        "other thread serializes behind the slow operation"
+    )
+
+    def check(self, module, project):
+        analysis = ConcurrencyAnalysis.of(project)
+        seen: set[tuple] = set()
+        for key, entry in analysis.funcs.items():
+            if entry.module is not module:
+                continue
+            for node, desc, held in entry.blocking:
+                if not held:
+                    continue
+                pos = (node.lineno, node.col_offset)
+                if pos in seen:
+                    continue
+                seen.add(pos)
+                yield self._v(
+                    module,
+                    node,
+                    f"{desc} while holding {sorted(held)[0]!r} — move the "
+                    "blocking work outside the critical section",
+                )
+            for held, node, target, label in entry.calls:
+                if not held or target is None:
+                    continue
+                blocked = analysis.block_closure(target)
+                if not blocked:
+                    continue
+                pos = (node.lineno, node.col_offset)
+                if pos in seen:
+                    continue
+                seen.add(pos)
+                desc, chain = sorted(blocked.items())[0]
+                yield self._v(
+                    module,
+                    node,
+                    f"call to {label} reaches {desc} (via {chain}) while "
+                    f"holding {sorted(held)[0]!r} — move the call outside "
+                    "the critical section or split the helper",
+                )
+
+
+#: Calls a signal handler may make. Python handlers run on the MAIN
+#: thread between bytecodes: acquiring a lock the interrupted code holds
+#: deadlocks instantly, and buffered-I/O ``print`` can die with
+#: "RuntimeError: reentrant call" when the signal lands mid-print. The
+#: sanctioned moves: set a flag, ``os.write`` (unbuffered), raise, wake an
+#: Event, or hand the real work to a fresh thread.
+_HANDLER_SAFE_CALLS = {
+    "os.write", "os.kill", "os._exit", "signal.raise_signal",
+}
+
+
+class SignalHandlerUnsafeRule(Rule):
+    id = "signal-handler-unsafe"
+    summary = (
+        "a signal handler does more than set a flag / os.write / raise / "
+        "Event.set / spawn a thread — locks, blocking calls and buffered "
+        "I/O (print) in a handler deadlock or die reentrantly when the "
+        "signal lands at the wrong bytecode"
+    )
+
+    def _handler_target(self, call, module, analysis):
+        """The handler callable of a ``signal.signal(sig, handler)`` call:
+        a FunctionDef/Lambda node plus its class context, or None when the
+        handler is not statically resolvable (restore loops passing a
+        saved variable are deliberately skipped)."""
+        if len(call.args) < 2:
+            return None
+        handler = call.args[1]
+        if isinstance(handler, ast.Lambda):
+            return handler, None
+        if isinstance(handler, ast.Name):
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node.name == handler.id:
+                        return node, None
+        if (
+            isinstance(handler, ast.Attribute)
+            and isinstance(handler.value, ast.Name)
+            and handler.value.id == "self"
+        ):
+            for (path, cls_name), info in analysis.classes.items():
+                if path == module.path and handler.attr in info.methods:
+                    return info.methods[handler.attr], info
+        return None
+
+    def _enclosing_class(self, module, target_node):
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if sub is target_node:
+                        return node.name
+        return None
+
+    def check(self, module, project):
+        analysis = ConcurrencyAnalysis.of(project)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if resolve_dotted(node.func, module.aliases) != "signal.signal":
+                continue
+            resolved = self._handler_target(node, module, analysis)
+            if resolved is None:
+                continue
+            handler, cls = resolved
+            if cls is None:
+                # Lambdas and nested defs inherit the enclosing class's
+                # ``self`` (the SIGUSR1 idiom: ``lambda s, f:
+                # self.profiler.request(...)`` inside a method).
+                cls_name = self._enclosing_class(module, handler)
+                if cls_name is not None:
+                    cls = analysis.classes.get((module.path, cls_name))
+            yield from self._check_handler(
+                module, analysis, handler, cls, depth=0
+            )
+
+    def _check_handler(self, module, analysis, handler, cls, depth):
+        body = (
+            handler.body
+            if isinstance(handler, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else [ast.Expr(value=handler.body)]
+        )
+        for stmt in body:
+            yield from self._check_stmt(module, analysis, stmt, cls, depth)
+
+    def _check_stmt(self, module, analysis, stmt, cls, depth):
+        if isinstance(stmt, ast.With):
+            yield self._v(
+                module, stmt,
+                "with-statement (lock/resource acquisition) inside a "
+                "signal handler — if the signal lands while the main "
+                "thread holds the same lock, the handler deadlocks the "
+                "process; set a flag instead",
+            )
+            return
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            verdict = self._classify_call(module, analysis, node, cls, depth)
+            if verdict is not None:
+                yield self._v(module, node, verdict)
+
+    def _classify_call(self, module, analysis, call, cls, depth):
+        resolved = resolve_dotted(call.func, module.aliases)
+        if resolved in _HANDLER_SAFE_CALLS:
+            return None
+        if resolved in ("threading.Thread", "Thread"):
+            return None  # ctor of the defer-to-thread pattern (see start)
+        if resolved in ("str", "int", "float", "bytes", "repr", "len"):
+            return None  # pure in-memory conversion
+        if isinstance(call.func, ast.Attribute) and call.func.attr in (
+            "encode", "decode", "format",
+        ):
+            return None  # string shaping for an os.write payload
+        if resolved == "print":
+            return (
+                "print() inside a signal handler — buffered writers are "
+                "not reentrant (a signal landing mid-print raises "
+                "RuntimeError and crashes the run); use os.write on the "
+                "raw fd after setting the flag"
+            )
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "acquire" or (
+                cls is not None
+                and isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id == "self"
+                and cls.lock_attrs.get(func.value.attr)
+            ):
+                return (
+                    "lock operation inside a signal handler — deadlocks "
+                    "when the signal interrupts a holder on this thread"
+                )
+            if func.attr == "set" and not call.args:
+                return None  # Event.set — the wake-a-waiter idiom
+            # threading.Thread(...).start(): the sanctioned defer-to-
+            # thread pattern (the handler itself stays trivial).
+            if func.attr == "start" and isinstance(func.value, ast.Call):
+                ctor = resolve_dotted(func.value.func, module.aliases)
+                if ctor in ("threading.Thread", "Thread"):
+                    return None
+        desc = analysis._blocking_desc(call, module, cls, frozenset())
+        if desc is not None:
+            return f"{desc} inside a signal handler — handlers must not block"
+        target = analysis.resolve_call(call, module, cls)
+        if target is not None:
+            if depth >= 2:
+                return (
+                    f"call chain deeper than 2 from a signal handler "
+                    f"({_call_label(call)}) — keep handlers to a flag set"
+                )
+            entry = analysis.funcs.get(target)
+            if entry is not None:
+                target_cls = entry.cls
+                problems = list(
+                    self._check_handler(
+                        entry.module, analysis, entry.node, target_cls,
+                        depth + 1,
+                    )
+                )
+                if problems:
+                    return (
+                        f"call to {_call_label(call)} from a signal handler "
+                        f"reaches unsafe work ({problems[0].message[:120]})"
+                    )
+                return None
+        if isinstance(func, ast.Name) and func.id in (
+            "KeyboardInterrupt", "SystemExit", "RuntimeError",
+        ):
+            return None  # exception construction inside a raise
+        if resolved is not None and resolved.startswith(("os.", "signal.")):
+            return None  # os/signal-namespace calls are the safe surface
+        return (
+            f"unverifiable call {_call_label(call)} inside a signal "
+            "handler — handlers may only set flags, os.write, raise, wake "
+            "an Event, or spawn a worker thread"
+        )
+
+
+class ChiefOnlyWriteRule(Rule):
+    id = "chief-only-write"
+    summary = (
+        "a filesystem mutation in a chief-electing module (one that binds "
+        "a rank-0 flag from process_index) is reachable on every rank — "
+        "two ranks racing one tmp+rename corrupt the shared file"
+    )
+
+    #: Mutation primitives in scope (the tmp+rename class plus open-for-
+    #: write). Reads and makedirs(exist_ok=True) are rank-safe.
+    WRITE_CALLS = {"os.replace", "os.rename", "shutil.copyfile", "shutil.move"}
+    WRITE_TAILS = {
+        "save_checkpoint", "publish_alias", "publish_done_marker",
+        "save_to_json", "save_statistics", "save_model", "save_models",
+    }
+
+    def _chief_names(self, module) -> set[str]:
+        """Names bound as ``<x> = ... process_index ... == 0`` where the
+        target smells like an election flag."""
+        names: set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            target = node.targets[0]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name
+            ):
+                name = target.attr
+            if name is None or "chief" not in name.lower():
+                continue
+            source = ast.dump(node.value)
+            if "process_index" in source:
+                names.add(name)
+        return names
+
+    def _is_write_call(self, call, module) -> str | None:
+        resolved = resolve_dotted(call.func, module.aliases)
+        if resolved in self.WRITE_CALLS:
+            return resolved
+        if resolved == "open" and len(call.args) >= 2:
+            mode = call.args[1]
+            if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+                if set(mode.value) & set("wax+"):
+                    return f"open(..., {mode.value!r})"
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                if resolved == "open" and set(str(kw.value.value)) & set("wax+"):
+                    return f"open(..., mode={kw.value.value!r})"
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in self.WRITE_TAILS:
+            return func.attr
+        if isinstance(func, ast.Name) and func.id in self.WRITE_TAILS:
+            return func.id
+        return None
+
+    @staticmethod
+    def _guard_hits(test: ast.AST, chief_names: set[str]) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in chief_names:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in chief_names:
+                return True
+        return False
+
+    def _guard_line(self, fn, chief_names) -> int | None:
+        """Line of the early-return election (``if not chief: return``)
+        among the function's top-level statements — every statement after
+        it runs chief-only. Statements BEFORE the guard (path computation,
+        timers) are allowed as long as they are not themselves writes
+        (the caller checks write line vs guard line)."""
+        for stmt in fn.body:
+            if isinstance(stmt, ast.If) and self._guard_hits(
+                stmt.test, chief_names
+            ):
+                # The guard body may keep a little per-rank bookkeeping
+                # (timer resets) as long as it EXITS: only the last
+                # statement must be the return/raise.
+                body_exits = bool(stmt.body) and isinstance(
+                    stmt.body[-1], (ast.Return, ast.Raise)
+                )
+                negated = isinstance(stmt.test, ast.UnaryOp) and isinstance(
+                    stmt.test.op, ast.Not
+                )
+                if negated and body_exits:
+                    return stmt.lineno
+        return None
+
+    def _function_chief_safe(self, fn, chief_names) -> bool:
+        return self._guard_line(fn, chief_names) is not None
+
+    def check(self, module, project):
+        chief_names = self._chief_names(module)
+        if not chief_names:
+            return
+        # Pass 1: functions that only ever execute on the chief — either
+        # via the early-return election or because EVERY call site in the
+        # module sits under a positive chief guard / in a chief-only
+        # function (fixpoint over the module-local call graph).
+        functions: dict[str, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                functions.setdefault(node.name, node)
+        chief_only: set[str] = {
+            name for name, fn in functions.items()
+            if self._function_chief_safe(fn, chief_names)
+        }
+        # Parent map for "is this node under `if chief:`" checks.
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def under_positive_guard(node: ast.AST) -> bool:
+            cur = node
+            while cur is not None:
+                parent = parents.get(id(cur))
+                if isinstance(parent, ast.If) and cur in parent.body:
+                    test = parent.test
+                    negated = isinstance(test, ast.UnaryOp) and isinstance(
+                        test.op, ast.Not
+                    )
+                    if self._guard_hits(test, chief_names) and not negated:
+                        return True
+                cur = parent
+            return False
+
+        def enclosing_function(node: ast.AST):
+            cur = parents.get(id(node))
+            while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                cur = parents.get(id(cur))
+            return cur
+
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in functions.items():
+                if name in chief_only:
+                    continue
+                sites = []
+                for node in ast.walk(module.tree):
+                    if isinstance(node, ast.Call):
+                        callee = None
+                        if isinstance(node.func, ast.Name):
+                            callee = node.func.id
+                        elif isinstance(node.func, ast.Attribute) and isinstance(
+                            node.func.value, ast.Name
+                        ) and node.func.value.id in ("self", "cls"):
+                            callee = node.func.attr
+                        if callee == name:
+                            sites.append(node)
+                if not sites:
+                    continue
+                ok = True
+                for site in sites:
+                    enc = enclosing_function(site)
+                    if under_positive_guard(site):
+                        continue
+                    if enc is not None and enc.name in chief_only and (
+                        enc.name != name
+                    ):
+                        continue
+                    ok = False
+                    break
+                if ok:
+                    chief_only.add(name)
+                    changed = True
+
+        seen: set[tuple] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._is_write_call(node, module)
+            if what is None:
+                continue
+            enc = enclosing_function(node)
+            if under_positive_guard(node):
+                continue
+            if enc is not None:
+                guard = self._guard_line(enc, chief_names)
+                if guard is not None and node.lineno > guard:
+                    continue
+                if enc.name in chief_only:
+                    continue
+            # A call to a module-local writer that itself opens with the
+            # election (save_models guards internally) is already safe.
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Name
+            ) and node.func.value.id in ("self", "cls"):
+                callee = node.func.attr
+            if callee is not None and callee in functions and (
+                self._guard_line(functions[callee], chief_names) is not None
+            ):
+                continue
+            pos = (node.lineno, node.col_offset)
+            if pos in seen:
+                continue
+            seen.add(pos)
+            yield self._v(
+                module,
+                node,
+                f"filesystem mutation ({what}) reachable on every rank of "
+                "a chief-electing module — guard it with the rank-0 "
+                "election (or suppress with a reason if the path is "
+                "genuinely per-rank)",
+            )
+
+
+class ExitCodeContractRule(Rule):
+    id = "exit-code-contract"
+    summary = (
+        "an undeclared integer exit code in sys.exit/os._exit/SystemExit "
+        "(the registry lives in tools/graftlint/concurrency.py), or a "
+        "bare `except:` swallowing everything at a typed boundary"
+    )
+
+    EXIT_FUNCS = {"sys.exit", "os._exit"}
+
+    def check(self, module, project):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                resolved = resolve_dotted(node.func, module.aliases)
+                is_exit = resolved in self.EXIT_FUNCS or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "SystemExit"
+                )
+                if is_exit and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, int
+                    ) and not isinstance(arg.value, bool):
+                        if arg.value not in EXIT_CODE_REGISTRY:
+                            yield self._v(
+                                module,
+                                node,
+                                f"undeclared process exit code {arg.value} "
+                                "— add it to EXIT_CODE_REGISTRY (tools/"
+                                "graftlint/concurrency.py) with a meaning, "
+                                "or reuse a declared constant "
+                                f"({sorted(EXIT_CODE_REGISTRY)})",
+                            )
+            elif isinstance(node, ast.ExceptHandler) and node.type is None:
+                reraises = any(
+                    isinstance(sub, ast.Raise) and sub.exc is None
+                    for sub in ast.walk(node)
+                )
+                if not reraises:
+                    yield self._v(
+                        module,
+                        node,
+                        "bare `except:` swallows SystemExit/Keyboard"
+                        "Interrupt at a typed-exception boundary — catch "
+                        "Exception (or the typed error) instead, or "
+                        "re-raise",
+                    )
+
+
+CONCURRENCY_RULES = [
+    LockOrderInversionRule(),
+    BlockingUnderLockRule(),
+    SignalHandlerUnsafeRule(),
+    ChiefOnlyWriteRule(),
+    ExitCodeContractRule(),
+]

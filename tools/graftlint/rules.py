@@ -801,3 +801,10 @@ ALL_RULES: list[Rule] = [
     ThreadLifecycleRule(),
     DeviceProbeBeforeDistributedInitRule(),
 ]
+
+# The whole-program concurrency/contract rules (graftlint v2) live in
+# their own module around the shared project-wide lock model; imported
+# at the bottom so `Rule` exists when concurrency.py imports it back.
+from .concurrency import CONCURRENCY_RULES  # noqa: E402
+
+ALL_RULES.extend(CONCURRENCY_RULES)

@@ -238,6 +238,79 @@ def main(argv=None) -> int:
     telemetry_qps = statistics.median(telemetry_rates)
     telemetry_overhead_pct = statistics.median(pair_overheads)
 
+    # Lock-sanitizer overhead (utils/locksan.py): same paired-window
+    # protocol as the telemetry key. The sanitizer instruments locks at
+    # CREATION time, so a second identical API is built inside an active
+    # sanitizer — its engine/batcher/cache/metrics locks are all
+    # instrumented (deactivation restores the factories but instrumented
+    # locks keep recording) — and the hot path alternates between the
+    # plain and the sanitized instance. The observed acquisition-order
+    # graph rides along: serve_locksan_cycles must be 0 on every run.
+    from howtotrainyourmamlpytorch_tpu.utils.locksan import LockSanitizer
+
+    # BOTH instances are built fresh so their internal state (latency
+    # ring buffers, cache fill, batcher margin history) ages identically
+    # — comparing a fresh sanitized API against the run's aged primary
+    # would measure instance age, not the sanitizer (observed: a fresh
+    # instance is ~40% FASTER than one whose 2048-sample windows are
+    # full, dwarfing any real overhead).
+    san = LockSanitizer()
+    api_plain2 = build_api(
+        opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512
+    )
+    with san:
+        api_san = build_api(
+            opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512
+        )
+    for pair_api in (api_plain2, api_san):
+        sanitized_api = pair_api is api_san
+        if sanitized_api:
+            san.activate()
+        try:
+            pair_api.engine.warmup([(way, opts.shot, opts.query)])
+            pair_api.classify(xs, ys, xq)  # prime the cache entry
+            # Full-window settle: a fresh instance speeds up considerably
+            # over its first seconds (latency windows filling, allocator
+            # steady state); measuring before the curve flattens poisons
+            # the first pair.
+            offered_qps(pair_api, hot_pool, per_window, opts.threads)
+        finally:
+            if sanitized_api:
+                san.deactivate()
+    locksan_windows = hot_windows + 2  # outvote any residual warm-in pair
+    san_plain_rates, san_rates, san_pair_overheads = [], [], []
+    for w in range(locksan_windows):
+        pair = {}
+        order = (False, True) if w % 2 == 0 else (True, False)
+        for sanitized in order:
+            # Sanitized windows run with the factories ACTIVE, exactly
+            # like the tier-1 autouse fixture: the per-request cost (each
+            # batcher submit creates a Future whose lock comes from the
+            # threading factory, plus a creation-site frame walk) must be
+            # inside the measurement, not just the construction-time
+            # locks of build_api.
+            if sanitized:
+                san.activate()
+            try:
+                rate = offered_qps(
+                    api_san if sanitized else api_plain2, hot_pool,
+                    per_window, opts.threads, errors=bench_errors,
+                )
+            finally:
+                if sanitized:
+                    san.deactivate()
+            pair[sanitized] = rate
+            (san_rates if sanitized else san_plain_rates).append(rate)
+        san_pair_overheads.append(
+            (pair[False] - pair[True]) / pair[False] * 100.0
+        )
+    serve_locksan_qps = statistics.median(san_rates)
+    serve_locksan_plain_qps = statistics.median(san_plain_rates)
+    serve_locksan_overhead_pct = statistics.median(san_pair_overheads)
+    serve_locksan_cycles = len(san.cycles())
+    api_plain2.close()
+    api_san.close()
+
     # Resilience phase: open-loop Poisson loadtest against a 2-replica
     # LocalReplica pool with a replica kill injected mid-stream — the
     # "survives overload and replica death" keys are measured, not claimed.
@@ -328,6 +401,13 @@ def main(argv=None) -> int:
         "telemetry_pair_overheads_pct": [
             round(o, 3) for o in pair_overheads
         ],
+        "serve_locksan_qps": round(serve_locksan_qps, 3),
+        "serve_locksan_plain_qps": round(serve_locksan_plain_qps, 3),
+        "serve_locksan_overhead_pct": round(serve_locksan_overhead_pct, 3),
+        "locksan_pair_overheads_pct": [
+            round(o, 3) for o in san_pair_overheads
+        ],
+        "serve_locksan_cycles": serve_locksan_cycles,
         "serve_compiles": {
             "programs": len(compile_table),
             "total_traces": sum(compile_table.values()),
