@@ -50,12 +50,14 @@ BASELINE_META_ITERS_PER_S = 0.55
 EMITTED_KEYS = (
     "metric", "value", "unit", "vs_baseline",
     "peak_meta_iters_per_s", "sustained_meta_iters_per_s", "mfu",
+    "mfu_pct", "hbm_peak_bytes",
     "bf16_meta_iters_per_s", "f32_wire_meta_iters_per_s",
     "real_data_meta_iters_per_s", "real_data_vs_baseline",
     "real_data_k25_meta_iters_per_s",
     "real_data_data_wait_frac", "real_data_stage_wait_frac",
     "k1_meta_iters_per_s", "dispatch_overhead_ms",
     "imagenet_shape_meta_iters_per_s", "imagenet_shape_mfu",
+    "imagenet_shape_mfu_pct", "imagenet_shape_hbm_peak_bytes",
     "imagenet_shape_fused_train_meta_iters_per_s",
     "imagenet_shape_fused_train_pool_meta_iters_per_s",
     "imagenet_shape_lane_pad_meta_iters_per_s",
@@ -103,16 +105,16 @@ DISPATCH_CHUNK = 25
 # window is reported; see _windowed_rates).
 REAL_DATA_WINDOWS = 3
 
-# Peak dense-matmul throughput per chip, bf16 (MFU denominator). v5e = 197
-# TFLOP/s; fall back to it for unknown kinds (reported MFU is then an
-# estimate against a v5e-class chip).
-PEAK_FLOPS_BY_KIND = {
-    "TPU v5 lite": 197.4e12,
-    "TPU v5e": 197.4e12,
-    "TPU v5": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
-}
+# Peak dense-matmul throughput per chip, bf16 (MFU denominator): ONE table,
+# owned by the device-resource ledger (telemetry/device.py) and shared with
+# the heartbeat's live mfu_pct; override per run with --peak_flops /
+# MAML_PEAK_FLOPS rather than editing.
+from howtotrainyourmamlpytorch_tpu.telemetry.device import (  # noqa: E402
+    PEAK_FLOPS_BY_KIND,
+    ProgramLedger,
+    record_train_program,
+    resolve_peak_flops,
+)
 
 
 # Quiet-chip sentinel norms, ms (median _sentinel_ms on an idle chip,
@@ -284,29 +286,23 @@ def _measure(cfg, repeats=100, K=DISPATCH_CHUNK, windows=5,
     return median, peak, mean, learner, batches, epoch, K
 
 
-def _flops_per_iter(learner, state_template, batches, epoch):
-    """FLOPs of one meta-iteration from the compiled program's own cost
-    analysis (falls back to None off-TPU or if the backend omits flops).
-
-    XLA's cost analysis counts a ``lax.scan``/while-loop BODY ONCE, not
-    times the trip count (verified on this backend: the reported flops of
-    the K-iteration scan program are identical for K=1/5/25, and agree
-    with a rough hand count of one meta-iteration to ~13% — inside the
-    hand count's own approximation error; PERF_NOTES.md "Corrected MFU
-    accounting"). The body cost therefore IS the per-iteration cost — do
-    NOT divide by the dispatch chunk K. Rounds 1-3 divided, understating
-    every reported MFU by 25x (1.68% reported vs ~45% true for the r3
-    flagship)."""
+def _train_program_entry(learner, state_template, batches, epoch):
+    """The compiled train program's resource row from the device-resource
+    ledger (telemetry/device.py) — FLOPs, HBM footprint, arithmetic
+    intensity. ONE accounting implementation: the scan-body-once rule and
+    the learner's DECLARED dispatch multiplier K live in the ledger, not
+    in a comment here (rounds 1-3 hand-divided by K and understated every
+    reported MFU by 25x — PERF_NOTES.md "Corrected MFU accounting"; that
+    class is now structurally impossible). Returns None off-backends that
+    omit cost analysis."""
     try:
-        cost = (
-            learner.lowered_train_iters(state_template, batches, epoch)
-            .compile()
-            .cost_analysis()
+        ledger = ProgramLedger(emit_events=False)
+        entry = record_train_program(
+            ledger, learner, state_template, batches, epoch
         )
-        if isinstance(cost, list):  # older jax returns [dict]
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
+        if entry is None or not entry.flops:
+            return None
+        return entry
     except Exception as exc:  # noqa: BLE001 — observability only
         print(f"# cost analysis unavailable: {exc}", file=sys.stderr)
         return None
@@ -1251,15 +1247,16 @@ def main() -> None:
     )
     value, peak, sustained, learner, batches, epoch, K = _measure(cfg)
 
-    # MFU: measured iters/s x FLOPs/iter / chip peak.
+    # MFU: measured iters/s x FLOPs/iter / chip peak — FLOPs and HBM
+    # footprint both read from the program ledger (K-multiplier encoded
+    # in code, telemetry/device.py).
     mfu = None
     kind = jax.devices()[0].device_kind
-    chip_peak_flops = next(
-        (v for k, v in PEAK_FLOPS_BY_KIND.items() if k in kind),
-        PEAK_FLOPS_BY_KIND["TPU v5 lite"],
-    )
+    chip_peak_flops = resolve_peak_flops(kind)
     state_template = learner.init_state(jax.random.PRNGKey(0))
-    flops = _flops_per_iter(learner, state_template, batches, epoch)
+    entry = _train_program_entry(learner, state_template, batches, epoch)
+    flops = entry.flops if entry is not None else None
+    hbm_peak_bytes = entry.hbm_peak_bytes if entry is not None else None
     if flops:
         mfu = value * flops / chip_peak_flops
 
@@ -1283,11 +1280,15 @@ def main() -> None:
     (im_value, _imp, _ims, im_learner, im_batches, im_epoch, _im_K) = _measure(
         imagenet_cfg, repeats=30, batch_size=2, shots=5, targets_per_class=15
     )
-    im_flops = _flops_per_iter(
+    im_entry = _train_program_entry(
         im_learner,
         im_learner.init_state(jax.random.PRNGKey(0)),
         im_batches,
         im_epoch,
+    )
+    im_flops = im_entry.flops if im_entry is not None else None
+    im_hbm_peak_bytes = (
+        im_entry.hbm_peak_bytes if im_entry is not None else None
     )
 
     # North-star de-bottlenecking A/B (ISSUE 9): the same program with each
@@ -1455,6 +1456,15 @@ def main() -> None:
                 "peak_meta_iters_per_s": round(peak, 4),
                 "sustained_meta_iters_per_s": round(sustained, 4),
                 "mfu": round(mfu, 6) if mfu is not None else None,
+                # Device-resource ledger keys (telemetry/device.py): MFU
+                # as a percentage (the heartbeat's live mfu_pct rides the
+                # same ledger) and the compiled train program's static
+                # HBM bound (arguments + outputs + temps) — the
+                # --task_chunk HBM-spill lever's direct readout.
+                "mfu_pct": (
+                    float(f"{100.0 * mfu:.6g}") if mfu is not None else None
+                ),
+                "hbm_peak_bytes": hbm_peak_bytes,
                 "bf16_meta_iters_per_s": round(bf16_value, 4),
                 "f32_wire_meta_iters_per_s": round(f32_value, 4),
                 "real_data_meta_iters_per_s": (
@@ -1495,6 +1505,11 @@ def main() -> None:
                     round(im_value * im_flops / chip_peak_flops, 6)
                     if im_flops else None
                 ),
+                "imagenet_shape_mfu_pct": (
+                    float(f"{100.0 * im_value * im_flops / chip_peak_flops:.6g}")
+                    if im_flops else None
+                ),
+                "imagenet_shape_hbm_peak_bytes": im_hbm_peak_bytes,
                 # North-star de-bottlenecking A/B keys (ISSUE 9): one key
                 # per lever on the same program, plus the all-levers
                 # composition — flags off by default pending the quiet-chip

@@ -38,6 +38,11 @@ import numpy as np
 from .data.device_prefetch import AUTO_DEPTH, DevicePrefetcher
 from .models.common import StagedBatch, prepare_batch
 from .telemetry import TrainTelemetry
+from .telemetry.device import (
+    OOM_EXIT_CODE,
+    is_resource_exhausted,
+    write_oom_report,
+)
 from .utils import faultinject
 from .utils.checkpoint import (
     AsyncCheckpointWriter,
@@ -261,6 +266,9 @@ class ExperimentBuilder:
             profile_trigger_path=str(
                 getattr(args, "profile_trigger_path", "") or ""
             ),
+            # MFU denominator override (--peak_flops; 0/absent = auto from
+            # the device kind via telemetry/device.py's per-backend table).
+            peak_flops=float(getattr(args, "peak_flops", 0.0) or 0.0) or None,
         )
         # Live introspection: the heartbeat (logs/status.json, atomic
         # tmp+rename at the existing forced-read boundaries) carries
@@ -568,6 +576,63 @@ class ExperimentBuilder:
         )
         self.telemetry.shutdown()
 
+    def _oom_levers(self) -> dict:
+        """The config knobs that relieve device-memory pressure, recorded
+        verbatim in the OOM report so the operator (or a future auto-
+        degrader) reads the available levers next to the failure instead
+        of reconstructing them from flags: smaller meta-batch, task
+        chunking, shallower prefetch, bf16 compute, rematerialization."""
+        args = self.args
+
+        def read(name, default=None):
+            return getattr(args, name, default)
+
+        return {
+            "batch_size": read("batch_size"),
+            "task_chunk": read("task_chunk", 0),
+            "iters_per_dispatch": self.iters_per_dispatch,
+            "device_prefetch": self.device_prefetch,
+            "compute_dtype": read("compute_dtype"),
+            "lane_pad_channels": read("lane_pad_channels"),
+            "remat_inner_steps": read("remat_inner_steps", True),
+            "number_of_training_steps_per_iter": read(
+                "number_of_training_steps_per_iter"
+            ),
+            "num_target_samples": read("num_target_samples"),
+            "data_parallel_devices": read("data_parallel_devices", 0),
+        }
+
+    def _handle_oom(self, exc: BaseException) -> None:
+        """Bounded OOM unwind (mirrors ``_on_hang``'s shape): write the
+        forensics document, append the audit row, buffer the typed event —
+        the caller exits with :data:`~..telemetry.device.OOM_EXIT_CODE`
+        and ``run_experiment``'s finally drains/flushes as usual."""
+        report_path = os.path.join(self.logs_filepath, "oom_report.json")
+        write_oom_report(
+            report_path,
+            ledger=self.telemetry.ledger,
+            error=exc,
+            config_levers=self._oom_levers(),
+            current_iter=int(self.state["current_iter"]),
+        )
+        try:
+            self._write_interruption_row(kind="oom")
+        except OSError:
+            pass  # forensics must not mask the failure
+        self.telemetry.event(
+            "oom",
+            iter=int(self.state["current_iter"]),
+            code=OOM_EXIT_CODE,
+            error=str(exc)[:500],
+            report=os.path.basename(report_path),
+        )
+        print(
+            f"RESOURCE_EXHAUSTED at iteration "
+            f"{self.state['current_iter']}: forensics written to "
+            f"{report_path}; exiting with code {OOM_EXIT_CODE}",
+            file=sys.stderr,
+        )
+
     def _pending_nonfinite_trips(self) -> float:
         """Sentinel trips in the epoch-so-far accumulated metrics (forces
         the pending device scalars — only called on the shutdown path)."""
@@ -861,10 +926,19 @@ class ExperimentBuilder:
         # collective.
         with self._armed(current_iter + 1):
             faultinject.hang_due(current_iter)
+            faultinject.oom_due(current_iter)
             self.train_state, losses = self.model.run_train_iter(
                 self.train_state, data_batch, epoch=epoch_idx
             )
             self._record_dispatch(upto_iter=current_iter + 1)
+            # Device-resource ledger: a compile event during the dispatch
+            # above armed the pending flag; resolve it ONCE via the
+            # learner's AOT hook (cache-hit compile — zero new XLA
+            # compiles, zero device reads; no-op in steady state).
+            self.telemetry.ingest_train_program(
+                self.model, self.train_state, data_batch, epoch_idx,
+                single=True,
+            )
             # Metrics are device scalars; they are appended UNREAD so the
             # host never blocks on the step it just dispatched (the summary
             # forces them at epoch boundaries). Reading per-iteration here
@@ -907,10 +981,17 @@ class ExperimentBuilder:
         # path; the hang fault stalls at the group's first iteration.
         with self._armed(current_iter + n_iters):
             faultinject.hang_due(current_iter)
+            faultinject.oom_due(current_iter)
             self.train_state, losses = self.model.run_train_iters(
                 self.train_state, batches, epoch=epoch_idx
             )
             self._record_dispatch(n_iters, upto_iter=current_iter + n_iters)
+            # Ledger ingest for the K-scan program (see train_iteration):
+            # the learner's declared K multiplier rides the same hook.
+            self.telemetry.ingest_train_program(
+                self.model, self.train_state, batches, epoch_idx,
+                single=False,
+            )
             for key, value in losses.items():
                 total_losses.setdefault(key, []).append(value)
             current_iter += n_iters
@@ -1172,7 +1253,22 @@ class ExperimentBuilder:
             # the event buffer on EVERY exit path (return, clean pause,
             # preemption-requeue, crash).
             with self.telemetry.activate():
-                return self._run_experiment()
+                try:
+                    return self._run_experiment()
+                except RuntimeError as exc:
+                    # Device allocation failure (XlaRuntimeError carries
+                    # RESOURCE_EXHAUSTED and subclasses RuntimeError) at
+                    # any dispatch boundary: dump forensics FIRST
+                    # (logs/oom_report.json — top programs by temp-buffer
+                    # footprint, live watermarks, the HBM levers), then
+                    # exit through the REGISTERED code so the supervisor
+                    # reads a diagnosis, not a bare crash. Requeueing the
+                    # same config would OOM again — deliberately NOT the
+                    # requeue code.
+                    if not is_resource_exhausted(exc):
+                        raise
+                    self._handle_oom(exc)
+                    sys.exit(OOM_EXIT_CODE)
         finally:
             if self._watchdog is not None:
                 self._watchdog.close()
@@ -1472,12 +1568,23 @@ class ExperimentBuilder:
         num_val_batches = int(
             self.args.num_evaluation_tasks / self.args.batch_size
         )
+        val_sample = None
         for val_sample in self.data.get_val_batches(
             total_batches=num_val_batches, augment_images=False
         ):
             total_losses = self.evaluation_iteration(
                 val_sample=val_sample, total_losses=total_losses,
                 phase="val",
+            )
+        if val_sample is not None and not self._multihost:
+            # The first boundary compiles the eval program; the ledger
+            # records it here like the train programs (cache-hit AOT).
+            # Multi-host runs skip it: the dispatched program saw the
+            # STAGED global batch layout, so a host-side re-lower would
+            # be a genuine second compile, not a cache hit.
+            self.telemetry.ingest_eval_program(
+                self.model, self.train_state,
+                tuple(val_sample[:4]),
             )
         val_losses = self.build_summary_dict(total_losses, phase="val")
         # GD's eval mutates the persisted state: check val trips

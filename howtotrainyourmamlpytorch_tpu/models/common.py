@@ -276,6 +276,37 @@ class StagedBatch(NamedTuple):
     first_iter: int
 
 
+def dispatch_multiplier(data_batches) -> int:
+    """The DECLARED scan-dispatch multiplier K of one train dispatch group
+    — the number of meta-iterations one device dispatch performs.
+
+    This is load-bearing accounting, not bookkeeping: XLA's
+    ``cost_analysis()`` reports a ``lax.scan`` BODY once, not × the trip
+    count, so every FLOPs/MFU consumer must multiply by K. The multiplier
+    used to live in prose ("Corrected MFU accounting": rounds 1-3 divided
+    by K and understated MFU 25×); declaring it here, next to the batch
+    forms the learners actually dispatch, makes the understatement class
+    structurally impossible — the ledger (telemetry/device.py) reads THIS.
+
+    Accepted forms (exactly ``run_train_iters``' contract):
+
+    * :class:`StagedBatch` — the stager's declared ``n_iters``;
+    * the pre-stacked 4/5-tuple of arrays — the leading K axis;
+    * a sequence of K episode batches — its length;
+    * a single episode batch consumed by ``run_train_iter`` — 1.
+    """
+    if isinstance(data_batches, StagedBatch):
+        return max(int(data_batches.n_iters), 1)
+    try:
+        n = len(data_batches)
+    except TypeError:
+        return 1
+    if n in (4, 5) and all(hasattr(b, "ndim") for b in data_batches):
+        first = data_batches[0]
+        return max(int(np.shape(first)[0]), 1) if first.ndim > 0 else 1
+    return max(n, 1)
+
+
 class DeviceAugment(NamedTuple):
     """Static spec of the on-device (in-step) episode augmentation.
 

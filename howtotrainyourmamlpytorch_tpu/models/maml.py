@@ -55,6 +55,7 @@ from .common import (
     cosine_epoch_lr,
     decode_augment_images,
     decode_images,
+    dispatch_multiplier,
     guard_nonfinite_update,
     named_partial,
     nonfinite_flag,
@@ -449,11 +450,81 @@ class MAMLFewShotLearner(CheckpointableLearner):
 
     def lowered_train_iters(self, state: TrainState, data_batches, epoch):
         """Lowers (without running) the same program ``run_train_iters``
-        dispatches — for cost analysis / AOT inspection (bench.py MFU)."""
+        dispatches — AOT inspection for the program ledger
+        (telemetry/device.py; bench.py and tools/profile_step.py consume
+        it through ``ledger_train_program`` below, which also declares the
+        scan-dispatch K multiplier the raw cost analysis does NOT carry)."""
         step_fn, batches, importance = self._train_iters_program(
             data_batches, int(epoch)
         )
         return step_fn.lower(state, batches, jnp.asarray(importance))
+
+    def lowered_train_iter(self, state: TrainState, data_batch, epoch):
+        """K=1 twin of :meth:`lowered_train_iters`: the exact
+        ``_train_step`` program ``run_train_iter`` dispatches for this
+        epoch's variant (second order, MSL final-only). Same jit wrapper,
+        same avals — on an already-running loop ``.compile()`` on this
+        lowering is a cache hit, never a second XLA compile."""
+        epoch = int(epoch)
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else self._prepare_batch(data_batch)
+        )
+        final_only = not (
+            self.cfg.use_multi_step_loss_optimization
+            and epoch < self.cfg.multi_step_loss_num_epochs
+        )
+        step_fn = self._get_train_step(
+            self._use_second_order(epoch), final_only
+        )
+        return step_fn.lower(
+            state, batch, jnp.asarray(self._train_importance(epoch))
+        )
+
+    # -- program-ledger declarations (telemetry/device.py) --------------
+
+    def ledger_train_program(
+        self, state: TrainState, data_batches, epoch, single: bool = False
+    ):
+        """``(name, lowered, K)`` of the train program this learner would
+        dispatch — the ledger's single source of FLOPs/HBM accounting.
+        ``K`` is the DECLARED dispatch multiplier (``models/common.
+        dispatch_multiplier``): XLA cost analysis counts the scan body
+        once, and encoding the ×K here (instead of a comment consumers
+        must remember) is what makes the 25×-MFU-understatement class
+        structurally impossible."""
+        if single:
+            return (
+                "_train_step",
+                self.lowered_train_iter(state, data_batches, epoch),
+                1,
+            )
+        return (
+            "multi",
+            self.lowered_train_iters(state, data_batches, epoch),
+            dispatch_multiplier(data_batches),
+        )
+
+    def ledger_eval_program(self, state: TrainState, data_batch):
+        """``(name, lowered, K)`` of the eval program
+        ``run_validation_iter`` dispatches (always K=1)."""
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else self._prepare_batch(data_batch)
+        )
+        cfg = self.cfg
+        final_only = (
+            cfg.number_of_evaluation_steps_per_iter
+            <= cfg.number_of_training_steps_per_iter
+        )
+        eval_fn = self._get_eval_step(final_only)
+        return (
+            "_evaluation_step",
+            eval_fn.lower(state, batch, self._eval_importance()),
+            1,
+        )
 
     # ------------------------------------------------------------------
     # Initialization

@@ -43,6 +43,7 @@ import numpy as np
 
 from ..models.common import encode_images
 from ..telemetry import events as telemetry_events
+from ..telemetry.device import ProgramLedger
 from ..utils import faultinject
 from .cache import AdaptedParamsCache, support_digest
 from .errors import SwapRejectedError
@@ -219,6 +220,13 @@ class ServingEngine:
         # this through /healthz to resume idempotently after a crash.
         self.published_digest: str | None = None
         self.published_source: str | None = None
+        # Per-bucket serve-program resource ledger (telemetry/device.py):
+        # one cost/memory row per compiled adapt/classify program,
+        # ingested at warmup and at first-bucket sight via the AOT path
+        # (cache-hit on the just-compiled executable — zero new program
+        # signatures on the hot path, pinned under compile_guard), and
+        # exported on /metrics next to the compile table.
+        self.ledger = ProgramLedger()
         self._adapt, self._classify = self._build_programs()
 
     # ------------------------------------------------------------------
@@ -300,6 +308,36 @@ class ServingEngine:
     def _note_bucket(self, bucket: tuple[int, int, int]) -> None:
         with self._warmed_lock:
             self._warmed_buckets.add(bucket)
+
+    def _ledger_record(
+        self, bucket, istate, xs=None, ys=None, stacked=None, xq=None,
+    ) -> None:
+        """Best-effort ledger ingest of this bucket's program pair. Labels
+        match the compile table's (``adapt:BxS`` / ``classify:BxT``), so
+        the /metrics program rows line up with the trace counters; the
+        ``has_entry`` check makes each label a one-time cost. AOT
+        ``lower().compile()`` on the engine's own jit wrappers with the
+        live dispatch arrays is a cache hit — zero new signatures, zero
+        device reads. The ledger is observability: any failure is
+        swallowed, never a failed dispatch."""
+        bucket_label = "x".join(str(d) for d in bucket)
+        try:
+            if xs is not None:
+                label = "adapt:" + "x".join(str(d) for d in xs.shape[:2])
+                if not self.ledger.has_entry(label):
+                    self.ledger.record_lowered(
+                        label, self._adapt.lower(istate, xs, ys),
+                        k=1, role="serve_adapt", bucket=bucket_label,
+                    )
+            if xq is not None and stacked is not None:
+                label = "classify:" + "x".join(str(d) for d in xq.shape[:2])
+                if not self.ledger.has_entry(label):
+                    self.ledger.record_lowered(
+                        label, self._classify.lower(istate, stacked, xq),
+                        k=1, role="serve_classify", bucket=bucket_label,
+                    )
+        except Exception:  # noqa: BLE001 — observability must not fail a dispatch
+            pass
 
     # ------------------------------------------------------------------
     # Request preparation
@@ -421,6 +459,7 @@ class ServingEngine:
 
         # --- adapt (cache misses only) ---------------------------------
         adapt_ms: float | None = None
+        xs = ys = None  # adapt inputs, kept for the ledger's AOT ingest
         artifacts: list[Tree | None] = [None] * len(eps)
         miss: list[int] = []
         for i, ep in enumerate(eps):
@@ -459,6 +498,9 @@ class ServingEngine:
         host = faultinject.poison_logits(np.asarray(logits))
         self.metrics.episodes_served.inc(len(eps))
         self._note_bucket(eps[0].bucket)
+        self._ledger_record(
+            eps[0].bucket, istate, xs=xs, ys=ys, stacked=stacked, xq=xq,
+        )
         self.ready = True
         # Per-episode confidence + nonfinite accounting: pure numpy over
         # the host logits already fetched above — zero new device syncs,
@@ -528,8 +570,13 @@ class ServingEngine:
             xs_b = self._pad_rows([ep.x_support])
             ys_b = self._pad_rows([ep.y_support])
             adapted = self._adapt(istate, xs_b, ys_b)
-            self._classify(istate, adapted, self._pad_rows([ep.x_query]))
+            xq_b = self._pad_rows([ep.x_query])
+            self._classify(istate, adapted, xq_b)
             self._note_bucket(ep.bucket)
+            self._ledger_record(
+                ep.bucket, istate, xs=xs_b, ys=ys_b,
+                stacked=adapted, xq=xq_b,
+            )
         self.ready = True
 
     # ------------------------------------------------------------------

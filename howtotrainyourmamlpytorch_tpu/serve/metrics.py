@@ -79,9 +79,15 @@ class ServeMetrics:
         total = hits + misses
         return hits / total if total else 0.0
 
-    def snapshot(self, *, queue_depth: int = 0, compile_table: dict | None = None):
+    def snapshot(self, *, queue_depth: int = 0,
+                 compile_table: dict | None = None,
+                 program_table: list | None = None):
         """``compile_table``: ``{program_label: trace_count}`` from the
-        engine (it owns the jit boundary and counts actual retraces)."""
+        engine (it owns the jit boundary and counts actual retraces).
+        ``program_table``: the engine's per-bucket serve-program resource
+        ledger rows (``telemetry/device.ProgramLedger.table()`` — FLOPs,
+        bytes, arithmetic intensity, HBM footprint per compiled
+        adapt/classify program)."""
         return {
             "requests_total": self.requests_total.value,
             "request_errors": self.request_errors.value,
@@ -110,10 +116,12 @@ class ServeMetrics:
                 for key, row in self.bucket_table().items()
             },
             "compiles": dict(compile_table or {}),
+            "programs": [dict(row) for row in (program_table or [])],
         }
 
     def render_prometheus(
-        self, *, queue_depth: int = 0, compile_table: dict | None = None
+        self, *, queue_depth: int = 0, compile_table: dict | None = None,
+        program_table: list | None = None,
     ) -> str:
         p = self.PREFIX
         lines = [
@@ -175,4 +183,30 @@ class ServeMetrics:
             lines.append(
                 f'{p}_program_compiles{{program="{label}"}} {count}'
             )
+        # Per-bucket serve-program resource ledger (telemetry/device.py):
+        # compiler-metadata gauges per compiled program, so a dashboard
+        # reads what each bucket's dispatch costs — not just how often it
+        # runs. Fields the backend could not analyze are simply omitted.
+        if program_table:
+            for metric, field in (
+                ("program_flops", "flops"),
+                ("program_bytes_accessed", "bytes_accessed"),
+                ("program_arithmetic_intensity", "arithmetic_intensity"),
+                ("program_hbm_peak_bytes", "hbm_peak_bytes"),
+                ("program_temp_bytes", "temp_bytes"),
+            ):
+                rows = [
+                    row for row in program_table
+                    if row.get(field) is not None
+                ]
+                if not rows:
+                    continue
+                lines.append(f"# TYPE {p}_{metric} gauge")
+                for row in sorted(rows, key=lambda r: str(r.get("name"))):
+                    label = row.get("name", "?")
+                    bucket = row.get("bucket") or ""
+                    lines.append(
+                        f'{p}_{metric}{{program="{label}",'
+                        f'bucket="{bucket}"}} {row[field]:g}'
+                    )
         return "\n".join(lines) + "\n"

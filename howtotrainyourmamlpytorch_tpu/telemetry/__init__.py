@@ -20,7 +20,16 @@ hardware allows" + "serves heavy traffic" are claims that need receipts):
   fractions, topology, checkpoint age, watchdog state); the dispatcher
   reads it to enrich interruption audit rows.
 * ``anomaly`` — rolling step-time/data-wait detector judged against the
-  run's OWN p95 window, emitting typed ``anomaly`` events.
+  run's OWN p95 window, emitting typed ``anomaly`` events; plus the
+  monotonic ``memory_growth`` detector over heartbeat-boundary
+  ``bytes_in_use`` samples (the live leak/spill signal).
+* ``device``  — the per-program FLOPs/HBM ledger (``ProgramLedger``):
+  ``cost_analysis``/``memory_analysis`` of every named step/serve program,
+  keyed by name + shape signature with the learner's DECLARED scan
+  dispatch multiplier K encoded in code; derived MFU against the
+  per-backend peak table (``--peak_flops`` override), live per-device
+  memory watermarks, and OOM forensics (``logs/oom_report.json`` + the
+  registered exit code).
 * ``runtime`` — ``TrainTelemetry``, the builder-facing composition root.
 
 Cross-rank correlation: every event carries the run-scoped ``trace_id``
@@ -34,7 +43,8 @@ slowest-rank attribution; ``--overhead-bench`` measures the
 ``telemetry_overhead_pct`` bench key (PERF_NOTES.md protocol).
 """
 
-from .anomaly import RollingAnomalyDetector
+from .anomaly import MemoryGrowthDetector, RollingAnomalyDetector
+from .device import ProgramLedger
 from .events import SCHEMA_VERSION, EventLog, EventReader, read_events
 from .heartbeat import HeartbeatWriter, heartbeat_path, read_heartbeat
 from .profiling import ProfilerController
@@ -47,6 +57,8 @@ __all__ = [
     "EventReader",
     "read_events",
     "RollingAnomalyDetector",
+    "MemoryGrowthDetector",
+    "ProgramLedger",
     "HeartbeatWriter",
     "heartbeat_path",
     "read_heartbeat",

@@ -22,6 +22,12 @@ hot path where ``TrainTelemetry.record_dispatch`` already runs):
 * an anomalous sample is NOT fed back into the window (one hang must not
   inflate p95 and mask the next one), and total emissions are capped so a
   pathological run cannot flood the JSONL.
+
+The device plane adds a second detector shape: :class:`MemoryGrowthDetector`
+watches per-device ``bytes_in_use`` ACROSS forced-read windows for a
+monotonic rise — the live leak/spill signal (the ``--task_chunk`` HBM-spill
+pathology, a host-side staging leak mirrored on-device). Spike logic cannot
+see it: a leak never exceeds 3× its own p95, it just never comes back down.
 """
 
 from __future__ import annotations
@@ -113,3 +119,85 @@ class RollingAnomalyDetector:
             "mean_s": total / len(samples),
             "p95_s": self._p95(samples),
         }
+
+
+#: Consecutive rising boundary samples before memory growth can fire.
+MEMORY_GROWTH_WINDOWS = 6
+
+#: Absolute floor on the rise (allocator jitter on a healthy run rounds
+#: to megabytes; a real leak/spill climbs by buffers).
+MEMORY_GROWTH_MIN_DELTA_BYTES = 64 << 20
+
+#: Relative floor: the rise must also exceed this fraction of the value
+#: at the start of the rising run (a 64 MB climb on a 60 GB-resident
+#: program is still worth flagging only once it compounds).
+MEMORY_GROWTH_MIN_FRAC = 0.02
+
+#: Report cap (JSONL flood guard, like the rolling detector's).
+MEMORY_GROWTH_MAX_REPORTS = 20
+
+
+class MemoryGrowthDetector:
+    """Monotonic ``bytes_in_use`` growth across forced-read windows.
+
+    Fed one sample per heartbeat boundary (``TrainTelemetry`` samples
+    ``device.memory_stats()`` where the backend provides it — pure host
+    allocator counters, zero device syncs, and simply never fed on CPU).
+    Fires a typed ``memory_growth`` anomaly payload when ``consecutive``
+    successive samples each rose AND the total rise clears both the
+    absolute and relative floors; after firing, the rise anchor resets so
+    a continuing leak fires again only after another full climb."""
+
+    def __init__(
+        self,
+        consecutive: int = MEMORY_GROWTH_WINDOWS,
+        min_delta_bytes: int = MEMORY_GROWTH_MIN_DELTA_BYTES,
+        min_frac: float = MEMORY_GROWTH_MIN_FRAC,
+        max_reports: int = MEMORY_GROWTH_MAX_REPORTS,
+    ):
+        if consecutive < 2:
+            raise ValueError(f"consecutive must be >= 2, got {consecutive}")
+        self.consecutive = int(consecutive)
+        self.min_delta_bytes = int(min_delta_bytes)
+        self.min_frac = float(min_frac)
+        self.max_reports = int(max_reports)
+        self.reports = 0
+        self._last: int | None = None
+        self._anchor: int | None = None  # bytes at the start of the rise
+        self._rising = 0
+
+    def observe(self, bytes_in_use: int) -> dict | None:
+        """Feeds one boundary sample; returns the anomaly payload when the
+        monotonic-rise rule fires (caller emits the typed event)."""
+        value = int(bytes_in_use)
+        if self._last is None or value <= self._last:
+            # Flat or falling: a healthy steady state — reset the run.
+            self._last = value
+            self._anchor = value
+            self._rising = 0
+            return None
+        self._rising += 1
+        self._last = value
+        anchor = self._anchor if self._anchor is not None else value
+        rise = value - anchor
+        if (
+            self._rising >= self.consecutive
+            and rise >= self.min_delta_bytes
+            and rise >= self.min_frac * max(anchor, 1)
+        ):
+            self.reports += 1
+            payload = None
+            if self.reports <= self.max_reports:
+                payload = {
+                    "kind": "memory_growth",
+                    "bytes_in_use": value,
+                    "rise_bytes": rise,
+                    "windows": self._rising,
+                    "anchor_bytes": anchor,
+                }
+            # Re-arm: a continuing leak must climb a full delta again
+            # before the next report (bounded JSONL, unbounded leak).
+            self._anchor = value
+            self._rising = 0
+            return payload
+        return None

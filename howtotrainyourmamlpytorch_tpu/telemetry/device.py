@@ -1,0 +1,484 @@
+"""Device-resource observability: the per-program FLOPs/HBM ledger.
+
+The host plane (events, heartbeat, anomaly, fleet report) tells you what
+the RUN is doing; this module tells you what each COMPILED PROGRAM costs
+and what the chips are doing right now — the roofline lens (FLOPs, bytes,
+arithmetic intensity, HBM footprint) as a continuously emitted signal
+instead of an ad-hoc ``tools/profile_step.py`` session.
+
+Contracts (the ones tier-1 pins):
+
+* **One accounting implementation.** ``compiled.cost_analysis()`` counts a
+  ``lax.scan`` BODY once, not × the trip count (verified on this backend;
+  PERF_NOTES.md "Corrected MFU accounting" — dividing by the dispatch
+  chunk K understated every reported MFU by 25×). The ledger therefore
+  stores the body cost as the per-ITERATION cost and multiplies by the
+  learner's **declared dispatch multiplier** K for per-dispatch numbers —
+  the 25×-understatement class is structurally impossible because the
+  multiplier is data the learner declares (``models/common.
+  dispatch_multiplier``), not a comment someone must remember.
+* **Zero new compiles, zero new syncs.** Ledger ingest uses the AOT path
+  (``jit.lower(...).compile()``) with the SAME jit wrapper and avals the
+  live dispatch used, which is a cache hit on an already-compiled program
+  (pinned under ``compile_guard`` on the real K=1 and K=25 train paths and
+  the serve hot path); analysis reads host-side compiler metadata, never
+  a ``jax.device_get``.
+* **Graceful degradation.** ``memory_analysis()`` raising (unsupported
+  backend), ``cost_analysis()`` omitting keys, ``device.memory_stats()``
+  returning nothing (CPU) — all degrade to ``None`` fields, never an
+  exception on a training or serving path.
+
+OOM forensics: a ``RESOURCE_EXHAUSTED`` surfacing at any dispatch boundary
+is converted by the builder into ``logs/oom_report.json`` (top programs by
+temp-buffer footprint, live per-device watermarks, the config levers that
+relieve HBM pressure) and the registered exit code
+:data:`OOM_EXIT_CODE` — proven deterministically by the ``oom_at_iter``
+fault hook (``utils/faultinject.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+from . import events as telemetry_events
+
+#: Peak dense-matmul throughput per chip, bf16 (the MFU denominator).
+#: v5e = 197 TF/s; unknown kinds fall back to it, so off-TPU MFU numbers
+#: are estimates against a v5e-class chip (CPU rows are protocol noise).
+#: Override per run with ``--peak_flops`` / :data:`PEAK_FLOPS_ENV` rather
+#: than editing the table.
+PEAK_FLOPS_BY_KIND = {
+    "TPU v5 lite": 197.4e12,
+    "TPU v5e": 197.4e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
+
+#: Environment override of the peak-FLOPs table (a float, FLOP/s).
+PEAK_FLOPS_ENV = "MAML_PEAK_FLOPS"
+
+#: Fallback table row for unknown device kinds.
+DEFAULT_PEAK_KIND = "TPU v5 lite"
+
+#: Registered exit code of an OOM-terminated training run (see
+#: ``tools/graftlint/concurrency.EXIT_CODE_REGISTRY`` and the README
+#: exit-code table): the process wrote ``logs/oom_report.json`` first, so
+#: the supervisor reads forensics, not a bare crash. Distinct from 75/76 —
+#: requeueing the SAME config would OOM again; the report names the levers.
+OOM_EXIT_CODE = 77
+
+#: Substring every jax runtime allocation failure carries
+#: (``XlaRuntimeError: RESOURCE_EXHAUSTED: ...``).
+RESOURCE_EXHAUSTED_MARKER = "RESOURCE_EXHAUSTED"
+
+#: Schema stamp of ``logs/oom_report.json``.
+OOM_REPORT_SCHEMA = 1
+
+
+def resolve_peak_flops(
+    device_kind: str | None = None, override: float | None = None
+) -> float:
+    """The MFU denominator for this run: an explicit ``override`` (the
+    ``--peak_flops`` flag) wins, then :data:`PEAK_FLOPS_ENV`, then the
+    per-backend table matched by device-kind substring, then the
+    :data:`DEFAULT_PEAK_KIND` row. ``device_kind=None`` probes jax lazily
+    (callers that already know the kind pass it and stay jax-free)."""
+    if override:
+        return float(override)
+    env = os.environ.get(PEAK_FLOPS_ENV, "").strip()
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            print(
+                f"WARNING: ignoring malformed {PEAK_FLOPS_ENV}={env!r}",
+                file=sys.stderr,
+            )
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    for kind, peak in PEAK_FLOPS_BY_KIND.items():
+        if kind.lower() in device_kind.lower():
+            return peak
+    return PEAK_FLOPS_BY_KIND[DEFAULT_PEAK_KIND]
+
+
+def sample_memory_stats() -> list[dict] | None:
+    """Per-device live memory watermarks where the backend provides them
+    (``device.memory_stats()``): ``bytes_in_use`` / ``peak_bytes_in_use``
+    (+ ``bytes_limit`` when reported) per device. Returns ``None`` on
+    backends without the API (CPU) — graceful, never raising. A local
+    runtime query over host-side allocator counters: NOT a device sync."""
+    import jax
+
+    rows = []
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend-optional API
+            stats = None
+        if not stats:
+            continue
+        row = {"device": dev.id, "kind": dev.device_kind}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                    "largest_free_block_bytes"):
+            if key in stats:
+                row[key] = int(stats[key])
+        if "bytes_in_use" in row:
+            rows.append(row)
+    return rows or None
+
+
+@dataclasses.dataclass
+class ProgramEntry:
+    """One compiled program's resource row (all host-side metadata).
+
+    ``flops``/``bytes_accessed`` are PER-ITERATION (scan body counted
+    once — see the module contract); ``dispatch_flops`` is the declared
+    ``k`` × the body, the cost of one device dispatch."""
+
+    name: str
+    role: str = ""  # "train" | "eval" | "serve_adapt" | "serve_classify"
+    signature: str = ""
+    bucket: str | None = None  # serve-program bucket label ("5x1x1")
+    k: int = 1  # DECLARED dispatch multiplier (scan trip count)
+    flops: float | None = None
+    dispatch_flops: float | None = None
+    bytes_accessed: float | None = None
+    operand_bytes: float | None = None
+    output_bytes: float | None = None
+    arithmetic_intensity: float | None = None
+    argument_bytes: int | None = None
+    output_size_bytes: int | None = None
+    temp_bytes: int | None = None
+    generated_code_bytes: int | None = None
+    hbm_peak_bytes: int | None = None  # argument + output + temp
+    device_kind: str = ""
+    note: str = ""
+    t: float = 0.0
+
+    def as_row(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def analyze_cost(compiled) -> dict:
+    """``compiled.cost_analysis()`` → flops / bytes / operand-output split,
+    ``None`` fields where the backend omits them (some return a list of
+    per-computation dicts — the first is the entry computation)."""
+    out = {"flops": None, "bytes_accessed": None,
+           "operand_bytes": None, "output_bytes": None}
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        return out
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return out
+    flops = float(cost.get("flops", 0.0))
+    out["flops"] = flops if flops > 0 else None
+    byts = float(cost.get("bytes accessed", 0.0))
+    out["bytes_accessed"] = byts if byts > 0 else None
+    operand = sum(
+        float(v) for key, v in cost.items()
+        if isinstance(key, str) and key.startswith("bytes accessed operand")
+    )
+    output = sum(
+        float(v) for key, v in cost.items()
+        if isinstance(key, str) and key.startswith("bytes accessed output")
+    )
+    out["operand_bytes"] = operand or None
+    out["output_bytes"] = output or None
+    return out
+
+
+def analyze_memory(compiled) -> dict:
+    """``compiled.memory_analysis()`` → HBM footprint fields, all ``None``
+    when the backend does not implement the analysis (the degradation
+    contract ``tests/test_telemetry.py`` pins). ``hbm_peak_bytes`` is the
+    compiler's static live-buffer bound: arguments + outputs + temps."""
+    out = {"argument_bytes": None, "output_size_bytes": None,
+           "temp_bytes": None, "generated_code_bytes": None,
+           "hbm_peak_bytes": None}
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        return out
+    if mem is None:
+        return out
+    try:
+        out["argument_bytes"] = int(mem.argument_size_in_bytes)
+        out["output_size_bytes"] = int(mem.output_size_in_bytes)
+        out["temp_bytes"] = int(mem.temp_size_in_bytes)
+        out["generated_code_bytes"] = int(mem.generated_code_size_in_bytes)
+        out["hbm_peak_bytes"] = (
+            out["argument_bytes"] + out["output_size_bytes"]
+            + out["temp_bytes"]
+        )
+    except (AttributeError, TypeError, ValueError):
+        return {key: None for key in out}
+    return out
+
+
+class ProgramLedger:
+    """Host-side table of compiled-program resource rows, keyed by program
+    name + shape signature.
+
+    Rides the compile listener: ``note_compile`` (called from the
+    telemetry bridge on every XLA compile event) arms a pending flag;
+    owners resolve it OUTSIDE the per-dispatch hot work via the learner's
+    AOT hooks (``ExperimentBuilder._ledger_ingest``) or at first-bucket
+    sight (``serve/engine.py``). Every recorded entry is emitted as a
+    ``program_profile`` telemetry event (buffered — the host plane's
+    flush-at-boundaries contract applies). Thread-safe."""
+
+    def __init__(self, peak_flops: float | None = None,
+                 emit_events: bool = True):
+        self._peak_override = peak_flops
+        self._peak: float | None = None
+        self._device_kind: str | None = None
+        self.emit_events = bool(emit_events)
+        self._lock = threading.Lock()
+        self._entries: dict[tuple[str, str], ProgramEntry] = {}
+        self._pending = False
+        self._last_signature: dict[str, str] = {}
+
+    # -- compile-listener side -----------------------------------------
+
+    def note_compile(self, name: str, signature: str = "") -> None:
+        """One XLA compile happened (the sanitize.compile_listener bridge):
+        arm the pending flag so the owner resolves cost/memory analysis at
+        its next ingest point. Cheap; never touches the compiler."""
+        with self._lock:
+            self._pending = True
+            self._last_signature[name] = signature
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return self._pending
+
+    def clear_pending(self) -> None:
+        with self._lock:
+            self._pending = False
+
+    # -- ingest ---------------------------------------------------------
+
+    def _resolve_peak(self) -> float:
+        if self._peak is None:
+            try:
+                import jax
+
+                self._device_kind = jax.devices()[0].device_kind
+            except Exception:  # noqa: BLE001 — jax-free consumers
+                self._device_kind = ""
+            # A failed (or empty) probe degrades to the fallback table row
+            # — NEVER back into resolve_peak_flops's own jax probe, which
+            # would re-raise the exact exception just swallowed.
+            self._peak = resolve_peak_flops(
+                self._device_kind or DEFAULT_PEAK_KIND, self._peak_override
+            )
+        return self._peak
+
+    @property
+    def peak_flops(self) -> float:
+        return self._resolve_peak()
+
+    @property
+    def device_kind(self) -> str:
+        self._resolve_peak()
+        return self._device_kind or ""
+
+    def record_compiled(
+        self,
+        name: str,
+        compiled,
+        k: int = 1,
+        role: str = "",
+        signature: str | None = None,
+        bucket: str | None = None,
+        note: str = "",
+    ) -> ProgramEntry:
+        """Records one compiled program's cost/memory analysis. ``k`` is
+        the DECLARED dispatch multiplier; a later record with the same
+        (name, signature) key overwrites (program variants share avals —
+        the newest is the live one)."""
+        k = max(int(k), 1)
+        if signature is None:
+            with self._lock:
+                signature = self._last_signature.get(name, "")
+        entry = ProgramEntry(
+            name=str(name), role=str(role), signature=str(signature)[:160],
+            bucket=bucket, k=k, note=note, t=time.time(),
+            device_kind=self.device_kind,
+        )
+        cost = analyze_cost(compiled)
+        entry.flops = cost["flops"]
+        entry.bytes_accessed = cost["bytes_accessed"]
+        entry.operand_bytes = cost["operand_bytes"]
+        entry.output_bytes = cost["output_bytes"]
+        if entry.flops is not None:
+            entry.dispatch_flops = k * entry.flops
+            if entry.bytes_accessed:
+                entry.arithmetic_intensity = (
+                    entry.flops / entry.bytes_accessed
+                )
+        mem = analyze_memory(compiled)
+        entry.argument_bytes = mem["argument_bytes"]
+        entry.output_size_bytes = mem["output_size_bytes"]
+        entry.temp_bytes = mem["temp_bytes"]
+        entry.generated_code_bytes = mem["generated_code_bytes"]
+        entry.hbm_peak_bytes = mem["hbm_peak_bytes"]
+        with self._lock:
+            self._entries[(entry.name, entry.signature)] = entry
+        if self.emit_events:
+            telemetry_events.emit(
+                "program_profile",
+                peak_flops=self.peak_flops,
+                **{key: value for key, value in entry.as_row().items()
+                   if key != "t"},
+            )
+        return entry
+
+    def record_lowered(self, name: str, lowered, **kwargs) -> ProgramEntry:
+        """AOT form: ``lowered.compile()`` is a cache hit when the live
+        dispatch already compiled this program (the zero-new-compiles
+        contract; pinned under ``compile_guard``)."""
+        return self.record_compiled(name, lowered.compile(), **kwargs)
+
+    # -- queries ---------------------------------------------------------
+
+    def has_entry(self, name: str) -> bool:
+        with self._lock:
+            return any(key[0] == name for key in self._entries)
+
+    def entries(self) -> list[ProgramEntry]:
+        with self._lock:
+            return sorted(
+                self._entries.values(), key=lambda e: (e.role, e.name)
+            )
+
+    def table(self) -> list[dict]:
+        return [entry.as_row() for entry in self.entries()]
+
+    def train_entry(self) -> ProgramEntry | None:
+        """The newest train-step entry — the heartbeat's MFU numerator."""
+        with self._lock:
+            trains = [e for e in self._entries.values() if e.role == "train"]
+        return max(trains, key=lambda e: e.t) if trains else None
+
+    def mfu_pct(self, iters_per_s: float) -> float | None:
+        """Model-FLOPs utilization of the train program at the given
+        measured iteration rate, against this backend's peak (or the
+        override). Off-TPU this is an estimate vs the fallback row."""
+        entry = self.train_entry()
+        if entry is None or not entry.flops or iters_per_s <= 0:
+            return None
+        return 100.0 * iters_per_s * entry.flops / self.peak_flops
+
+    def top_by_temp_bytes(self, n: int = 8) -> list[dict]:
+        """Programs ranked by temp-buffer footprint — the OOM report's
+        "who is eating HBM" table."""
+        rows = [e.as_row() for e in self.entries()
+                if e.temp_bytes is not None]
+        rows.sort(key=lambda row: -(row["temp_bytes"] or 0))
+        return rows[:n]
+
+
+def record_train_program(
+    ledger: ProgramLedger, learner, state, data_batches, epoch,
+    single: bool = False,
+) -> ProgramEntry | None:
+    """Ingests the train program a learner would dispatch for this batch
+    group — name, AOT-lowered program and DECLARED dispatch multiplier all
+    come from the learner's ``ledger_train_program`` hook, so the K-scan
+    accounting lives in exactly one place. ``None`` for learners without
+    the hook."""
+    hook = getattr(learner, "ledger_train_program", None)
+    if hook is None:
+        return None
+    name, lowered, k = hook(state, data_batches, int(epoch), single=single)
+    return ledger.record_lowered(name, lowered, k=k, role="train")
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+
+class DeviceOOMError(RuntimeError):
+    """A device allocation failure (RESOURCE_EXHAUSTED) was caught at a
+    dispatch boundary and forensics were written; the process exits with
+    the registered :data:`OOM_EXIT_CODE`."""
+
+    def __init__(self, message: str, report_path: str | None = None):
+        super().__init__(message)
+        self.report_path = report_path
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """Whether ``exc`` is a device allocation failure. jaxlib's
+    ``XlaRuntimeError`` subclasses ``RuntimeError`` and stamps the XLA
+    status code into the message, so the check needs no jaxlib import —
+    which also lets the ``oom_at_iter`` fault hook raise a plain
+    ``RuntimeError`` through the identical detection path."""
+    return isinstance(exc, RuntimeError) and (
+        RESOURCE_EXHAUSTED_MARKER in str(exc)
+    )
+
+
+def write_oom_report(
+    path: str,
+    *,
+    ledger: ProgramLedger | None = None,
+    error: BaseException | None = None,
+    config_levers: dict | None = None,
+    current_iter: int | None = None,
+) -> dict:
+    """Dumps the OOM forensics document (atomic tmp+rename): what was
+    allocated when the chip ran out (live watermarks), which programs own
+    the biggest temp footprints (the ledger), and which config levers
+    relieve HBM pressure. Returns the document; I/O failure degrades to a
+    stderr warning + the in-memory document (forensics must not mask the
+    original failure)."""
+    # The runtime may be wedged AFTER a real OOM: even the watermark probe
+    # must not be allowed to raise past the forensics path and mask the
+    # registered exit code with a secondary traceback.
+    try:
+        watermarks = sample_memory_stats()
+    except Exception:  # noqa: BLE001 — forensics must not mask the OOM
+        watermarks = None
+    doc = {
+        "schema": OOM_REPORT_SCHEMA,
+        "t": time.time(),
+        "exit_code": OOM_EXIT_CODE,
+        "error": str(error)[:2000] if error is not None else None,
+        "current_iter": current_iter,
+        "memory_watermarks": watermarks,
+        "top_programs_by_temp_bytes": (
+            ledger.top_by_temp_bytes() if ledger is not None else []
+        ),
+        "programs_recorded": len(ledger.entries()) if ledger else 0,
+        "config_levers": dict(config_levers or {}),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as exc:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        print(
+            f"WARNING: could not write OOM report to {path} ({exc})",
+            file=sys.stderr,
+        )
+    return doc

@@ -33,14 +33,16 @@ from __future__ import annotations
 import contextlib
 import os
 import signal
+import sys
 import threading
 import time
 
 import numpy as np
 
 from ..utils.sanitize import compile_listener
+from . import device as device_ledger
 from . import events as telemetry_events
-from .anomaly import RollingAnomalyDetector
+from .anomaly import MemoryGrowthDetector, RollingAnomalyDetector
 from .events import EventLog
 from .heartbeat import HeartbeatWriter, heartbeat_path
 from .profiling import ProfilerController
@@ -70,6 +72,7 @@ class TrainTelemetry:
         process_index: int = 0,
         process_count: int = 1,
         trace_id: str | None = None,
+        peak_flops: float | None = None,
     ):
         self.enabled = bool(enabled)
         self.logs_dir = logs_dir
@@ -128,6 +131,20 @@ class TrainTelemetry:
         # dispatch against the run's own recent p95. Both are pure host
         # work on scalars the recorder already holds — zero new syncs.
         self.anomaly = RollingAnomalyDetector()
+        # Device-resource plane (telemetry/device.py): the per-program
+        # FLOPs/HBM ledger rides the compile bridge below (a compile event
+        # arms it; the builder resolves cost/memory analysis via the
+        # learner's AOT hooks — cache-hit, zero new compiles), and the
+        # memory-growth detector watches per-device bytes_in_use across
+        # heartbeat boundaries (the live leak/spill signal; never fed on
+        # backends without memory_stats).
+        self.ledger: device_ledger.ProgramLedger | None = (
+            device_ledger.ProgramLedger(peak_flops=peak_flops)
+            if self.enabled
+            else None
+        )
+        self.memory_growth = MemoryGrowthDetector()
+        self._ledger_warned = False
         self._heartbeat: HeartbeatWriter | None = (
             HeartbeatWriter(
                 heartbeat_path(logs_dir, process_index=self.process_index)
@@ -345,9 +362,8 @@ class TrainTelemetry:
         }
         steps = self.anomaly.window_stats("step_time")
         if steps is not None and steps["sum_s"] > 0:
-            payload["meta_iters_per_s"] = round(
-                steps["count"] / steps["sum_s"], 4
-            )
+            rate = steps["count"] / steps["sum_s"]
+            payload["meta_iters_per_s"] = round(rate, 4)
             payload["step_time_p95_s"] = round(steps["p95_s"], 6)
             for kind in ("data_wait", "stage_wait"):
                 waits = self.anomaly.window_stats(kind)
@@ -355,6 +371,22 @@ class TrainTelemetry:
                     payload[f"{kind}_frac"] = round(
                         waits["sum_s"] / steps["sum_s"], 6
                     )
+            # Windowed MFU: the window's measured rate × the ledger's
+            # K-corrected per-iteration FLOPs against the backend peak
+            # (--peak_flops override honored). Off-TPU this is an estimate
+            # vs the fallback peak row — the field exists either way so
+            # dashboards need no backend special-casing.
+            if self.ledger is not None:
+                mfu = self.ledger.mfu_pct(rate)
+                if mfu is not None:
+                    # Significant digits, not decimal places: off-TPU MFU
+                    # sits at 1e-4..1e-6 % and must not round to zero.
+                    payload["mfu_pct"] = float(f"{mfu:.6g}")
+                    payload["peak_flops"] = self.ledger.peak_flops
+                entry = self.ledger.train_entry()
+                if entry is not None and entry.hbm_peak_bytes is not None:
+                    payload["hbm_peak_bytes"] = entry.hbm_peak_bytes
+        self._observe_memory(payload, current_iter)
         if self.heartbeat_extra is not None:
             try:
                 extra = self.heartbeat_extra()
@@ -365,6 +397,43 @@ class TrainTelemetry:
         if payload.get("epoch") is not None:
             self._epoch = payload["epoch"]
         self._heartbeat.write(payload)
+
+    def _observe_memory(self, payload: dict, current_iter: int) -> None:
+        """Per-device memory watermarks at the heartbeat boundary
+        (``device.memory_stats()`` where the backend provides it — host
+        allocator counters, zero device syncs; simply absent on CPU), fed
+        to the monotonic-growth detector: a rise sustained across windows
+        is the live leak/spill signal, emitted as a typed ``memory_growth``
+        anomaly event and mirrored into the JSONL as a ``memory`` event so
+        the report can render watermarks post-hoc."""
+        try:
+            watermarks = device_ledger.sample_memory_stats()
+        except Exception:  # noqa: BLE001 — introspection must not kill
+            watermarks = None
+        if not watermarks:
+            return
+        payload["memory"] = watermarks
+        total_in_use = sum(w.get("bytes_in_use", 0) for w in watermarks)
+        peak = max(
+            (w.get("peak_bytes_in_use", 0) for w in watermarks), default=0
+        )
+        self.event(
+            "memory",
+            iter=int(current_iter),
+            devices=watermarks,
+            bytes_in_use_total=total_in_use,
+            peak_bytes_in_use_max=peak,
+        )
+        fired = self.memory_growth.observe(total_in_use)
+        if fired is not None:
+            self.registry.counter("anomalies").inc()
+            self.anomaly.reports += 1  # shares the heartbeat's anomaly count
+            self.event(
+                "anomaly",
+                iter=int(current_iter),
+                dispatch_id=int(current_iter),
+                **fired,
+            )
 
     def epoch_stats(self, phase: str = "train", epoch: int | None = None) -> dict:
         """Pops the epoch's per-iteration samples into the summary-CSV keys
@@ -440,8 +509,66 @@ class TrainTelemetry:
         attribute each compile to its rank — the per-rank compile-once pin
         of tests/test_multihost.py reads exactly this."""
         self.registry.counter("xla_compiles").inc()
+        if self.ledger is not None:
+            # Arm the device-resource ledger: the heavy cost/memory
+            # analysis is resolved by the owner at its next ingest point
+            # (ingest_train_program), never here in the log handler.
+            self.ledger.note_compile(event.name, event.signature)
         self.event(
             "compile",
             name=event.name,
             signature=event.signature[:_SIGNATURE_CHARS],
         )
+
+    # ------------------------------------------------------------------
+    # Device-resource ledger ingest (telemetry/device.py)
+    # ------------------------------------------------------------------
+
+    def _warn_ledger(self, exc: Exception) -> None:
+        if not self._ledger_warned:
+            self._ledger_warned = True
+            print(
+                f"WARNING: program-ledger ingest failed ({exc}); "
+                "device-resource telemetry degrades, training continues",
+                file=sys.stderr,
+            )
+
+    def ingest_train_program(
+        self, learner, state, data_batches, epoch, single: bool = False
+    ):
+        """Resolves a pending compile event into a ledger entry via the
+        learner's declared AOT hook: same jit wrapper + same avals as the
+        live dispatch, so ``lower().compile()`` is a CACHE HIT — zero new
+        XLA compiles, zero device reads (pinned under ``compile_guard``).
+        One-shot per compile event; learners without the hook no-op. The
+        ledger is observability: any failure degrades to a once-per-run
+        warning, never a crashed training step."""
+        ledger = self.ledger
+        if ledger is None or not ledger.has_pending():
+            return None
+        ledger.clear_pending()
+        try:
+            return device_ledger.record_train_program(
+                ledger, learner, state, data_batches, int(epoch),
+                single=single,
+            )
+        except Exception as exc:  # noqa: BLE001 — observability extra
+            self._warn_ledger(exc)
+            return None
+
+    def ingest_eval_program(self, learner, state, data_batch):
+        """Eval-program twin of :meth:`ingest_train_program` (the epoch
+        boundary's validation program joins the ledger the same way)."""
+        ledger = self.ledger
+        if ledger is None or not ledger.has_pending():
+            return None
+        ledger.clear_pending()
+        hook = getattr(learner, "ledger_eval_program", None)
+        if hook is None:
+            return None
+        try:
+            name, lowered, k = hook(state, data_batch)
+            return ledger.record_lowered(name, lowered, k=k, role="eval")
+        except Exception as exc:  # noqa: BLE001 — observability extra
+            self._warn_ledger(exc)
+            return None

@@ -32,7 +32,13 @@ This module provides the failure points those tests drive:
   device-prefetch stager while pulling the batch planned for iteration I
   (loader I/O blip / one corrupt episode), driving the stager's
   retry-then-skip quarantine policy — or its fail-fast branch when the
-  quarantine budget is exhausted.
+  quarantine budget is exhausted;
+* ``oom_at_iter`` — raise a ``RESOURCE_EXHAUSTED`` runtime error at
+  iteration I's dispatch boundary, exactly the message class jaxlib's
+  ``XlaRuntimeError`` (a ``RuntimeError`` subclass) carries when a device
+  allocation fails — driving the OOM-forensics path
+  (``telemetry/device.py``: ``logs/oom_report.json`` + the registered
+  exit code 77).
 
 Serve-path faults (the resilience layer's recovery paths, ``serve/pool.py``
 and ``serve/resilience`` — mirrored onto the request path exactly like the
@@ -116,6 +122,7 @@ class FaultPlan:
     sigkill_at_iter: int | None = None
     hang_at_iter: int | None = None
     producer_fail_at_iter: int | None = None
+    oom_at_iter: int | None = None
     replica_kill_at_request: int | None = None
     wedge_replica_at_request: int | None = None
     corrupt_swap_at: int | None = None
@@ -315,6 +322,28 @@ def hang_due(current_iter: int) -> None:
     deadline = time.monotonic() + HANG_STALL_CAP_S
     while time.monotonic() < deadline:
         time.sleep(0.05)
+
+
+def oom_due(current_iter: int) -> None:
+    """Raises the injected device-OOM at the dispatch that covers the
+    planned ``oom_at_iter`` (>= — like ``hang_due``, the builder calls
+    this with dispatch-GROUP start iterations). The message carries the
+    literal ``RESOURCE_EXHAUSTED`` marker, so it travels the IDENTICAL
+    detection path (``telemetry/device.is_resource_exhausted``) a real
+    ``XlaRuntimeError: RESOURCE_EXHAUSTED: ...`` allocation failure does —
+    jaxlib's error subclasses ``RuntimeError`` too."""
+    plan = _active()
+    if plan is None or plan.oom_at_iter is None:
+        return
+    if current_iter < plan.oom_at_iter:
+        return
+    plan.oom_at_iter = None
+    events.append(f"oom:{current_iter}")
+    raise RuntimeError(
+        "RESOURCE_EXHAUSTED: faultinject: injected device OOM while "
+        f"dispatching iteration {current_iter} (out of memory allocating "
+        "device buffer)"
+    )
 
 
 def producer_pull(current_iter: int) -> None:

@@ -241,6 +241,13 @@ def get_parser() -> argparse.ArgumentParser:
     add("--telemetry", type=str, default="True",
         help="False disables the structured event log (step-time CSV "
              "percentiles and profiling still work)")
+    add("--peak_flops", type=float, default=0.0,
+        help="per-chip peak FLOP/s used as the MFU denominator by the "
+             "device-resource ledger (telemetry/device.py: heartbeat "
+             "mfu_pct, program_profile events). 0 = auto from the device "
+             "kind via the per-backend table, falling back to the v5e "
+             "f32-mult peak on unknown backends; MAML_PEAK_FLOPS env "
+             "overrides too")
     # Trace-time sanitizers (opt-in, process-global jax.config switches;
     # see utils/sanitize.py and README "Static analysis & sanitizers").
     add("--debug_nans", type=str, default="False",

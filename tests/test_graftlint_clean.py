@@ -55,7 +55,21 @@ PLANES = {
     "telemetry": {
         "targets": [f"{PKG}/telemetry", "tools/telemetry_report.py"],
         "expect": {"registry.py", "events.py", "profiling.py", "runtime.py",
-                   "heartbeat.py", "anomaly.py", "telemetry_report.py"},
+                   "heartbeat.py", "anomaly.py", "device.py",
+                   "telemetry_report.py"},
+        "zero_suppressions": True,
+    },
+    "device-plane": {
+        # ISSUE 15: the per-program FLOPs/HBM ledger + its tool consumers
+        # (profile_step's accounting block, the report's device section,
+        # bench's mfu_pct/hbm_peak_bytes derivation) stay clean standalone
+        # with zero suppressions.
+        "targets": [
+            f"{PKG}/telemetry/device.py", "tools/profile_step.py",
+            "tools/telemetry_report.py", "bench.py",
+        ],
+        "expect": {"device.py", "profile_step.py", "telemetry_report.py",
+                   "bench.py"},
         "zero_suppressions": True,
     },
     "serve-resilience": {
@@ -120,7 +134,8 @@ PLANES = {
             "train_maml_system_dispatch.py", "bench.py",
         ],
         "expect": {"bench_judge.py", "telemetry_report.py", "heartbeat.py",
-                   "anomaly.py", "events.py", "runtime.py", "watchdog.py"},
+                   "anomaly.py", "device.py", "events.py", "runtime.py",
+                   "watchdog.py"},
         "zero_suppressions": True,
     },
     "control-plane": {

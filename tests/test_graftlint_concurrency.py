@@ -527,13 +527,16 @@ def test_exit_code_registry_matches_live_constants():
         REQUEUE_EXIT_CODE,
     )
     from howtotrainyourmamlpytorch_tpu.serve.api import REPLICA_KILL_EXIT
+    from howtotrainyourmamlpytorch_tpu.telemetry.device import OOM_EXIT_CODE
     from howtotrainyourmamlpytorch_tpu.utils.watchdog import HANG_EXIT_CODE
 
     assert REQUEUE_EXIT_CODE in EXIT_CODE_REGISTRY
     assert HANG_EXIT_CODE in EXIT_CODE_REGISTRY
     assert REPLICA_KILL_EXIT in EXIT_CODE_REGISTRY
+    assert OOM_EXIT_CODE in EXIT_CODE_REGISTRY
     assert EXIT_CODE_REGISTRY[75].startswith("preemption")
     assert "hang" in EXIT_CODE_REGISTRY[76]
+    assert "OOM" in EXIT_CODE_REGISTRY[77]
     assert 3 in EXIT_CODE_REGISTRY  # the miner's no-yield exit
 
 

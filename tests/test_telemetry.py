@@ -1022,3 +1022,346 @@ def test_serve_dispatch_events_carry_n_devices(tmp_path):
     # surrounding run's trace (env-inherited or self-started).
     assert dispatch["dispatch_id"] >= 1
     assert dispatch.get("trace_id")
+
+
+# ---------------------------------------------------------------------------
+# Device-resource observability plane (ISSUE 15): ProgramLedger, MFU,
+# watermarks, memory-growth anomaly, OOM forensics
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_ingest_on_k1_path_compile_once_zero_syncs(
+    compile_guard, rng, tmp_path, monkeypatch
+):
+    """The ledger's hot-path contract: resolving a pending compile into a
+    cost/memory row via the learner's AOT hook is a CACHE HIT inside the
+    counted window — still exactly one ``_train_step`` compile and zero
+    ``jax.device_get`` calls, with the ``program_profile`` event and the
+    heartbeat's windowed ``mfu_pct`` riding the existing boundaries."""
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    batch = tiny_batch(rng)
+    telemetry = TrainTelemetry(str(tmp_path), enabled=True)
+
+    device_gets = {"n": 0}
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        device_gets["n"] += 1
+        return real_device_get(x)
+
+    with telemetry.activate():
+        with compile_guard() as guard:
+            state, _ = learner.run_train_iter(state, batch, epoch=0)
+            telemetry.record_dispatch(1, n_iters=1)
+            monkeypatch.setattr(jax, "device_get", counting_device_get)
+            # Ledger ingest INSIDE the device_get-counted window: the AOT
+            # lower().compile() must be pure host work on the cache.
+            entry = telemetry.ingest_train_program(
+                learner, state, batch, 0, single=True
+            )
+            for i in range(2, 6):
+                state, _ = learner.run_train_iter(state, batch, epoch=0)
+                telemetry.record_dispatch(i, n_iters=1)
+                # Steady state: nothing pending, ingest is a None-check.
+                assert telemetry.ingest_train_program(
+                    learner, state, batch, 0, single=True
+                ) is None
+            telemetry.boundary(5, 0.0, reason="log")
+            monkeypatch.setattr(jax, "device_get", real_device_get)
+            jax.block_until_ready(state.theta)
+        guard.assert_compiles("_train_step", exactly=1)
+        guard.assert_unique_signatures("_train_step")
+    assert device_gets["n"] == 0
+    assert entry is not None and entry.role == "train" and entry.k == 1
+    assert entry.flops and entry.flops > 0
+    assert entry.dispatch_flops == entry.flops  # K=1
+    assert entry.hbm_peak_bytes is not None and entry.hbm_peak_bytes > 0
+    events = read_events(os.path.join(str(tmp_path), "telemetry.jsonl"))
+    profile = next(e for e in events if e["type"] == "program_profile")
+    assert profile["name"] == "_train_step"
+    assert profile["k"] == 1 and profile["flops"] == entry.flops
+    assert profile["peak_flops"] > 0
+    hb = json.load(open(os.path.join(str(tmp_path), "status.json")))
+    assert hb["mfu_pct"] > 0  # windowed rate x ledger flops / peak
+    assert hb["hbm_peak_bytes"] == entry.hbm_peak_bytes
+
+
+def test_ledger_k25_dispatch_flops_are_k_times_k1_body(
+    compile_guard, rng, tmp_path
+):
+    """THE regression test for the 25x-MFU-understatement class: the K=25
+    scan program's ledger accounting is exactly K x the K=1 body — the
+    declared dispatch multiplier is encoded in code (models/common.
+    dispatch_multiplier via maml.ledger_train_program), not re-derived by
+    each consumer. Also pins the K-scan compile-once contract with the
+    ledger active on the real K=25 path."""
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+    from howtotrainyourmamlpytorch_tpu.telemetry.device import (
+        ProgramLedger,
+        record_train_program,
+    )
+
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    batches = [tiny_batch(rng) for _ in range(25)]
+    telemetry = TrainTelemetry(str(tmp_path), enabled=True)
+    with telemetry.activate():
+        with compile_guard() as guard:
+            for d in range(2):
+                state, _ = learner.run_train_iters(state, batches, epoch=0)
+                telemetry.record_dispatch((d + 1) * 25, n_iters=25)
+                entry = telemetry.ingest_train_program(
+                    learner, state, batches, 0, single=False
+                )
+            jax.block_until_ready(state.theta)
+        guard.assert_compiles("multi", exactly=1)
+    assert entry is None or entry.k == 25  # second pass: nothing pending
+    ledger = telemetry.ledger
+    e25 = ledger.train_entry()
+    assert e25.k == 25 and e25.flops > 0
+    assert e25.dispatch_flops == 25 * e25.flops
+    # The K=1 body through the SAME accounting path (a separate program —
+    # compiled outside the guard; XLA counts both scan bodies once, so
+    # the per-iteration costs agree to reassociation-level noise and the
+    # dispatch costs differ by exactly the declared multiplier).
+    probe = ProgramLedger(emit_events=False)
+    e1 = record_train_program(probe, learner, state, batches[:1], 0)
+    assert e1.k == 1
+    assert e25.flops == pytest.approx(e1.flops, rel=1e-3)
+    assert e25.dispatch_flops == pytest.approx(
+        25 * e1.dispatch_flops, rel=1e-3
+    )
+
+
+def test_ledger_graceful_when_backend_omits_analyses():
+    """Backend degradation: ``memory_analysis`` raising and
+    ``cost_analysis`` omitting keys both degrade to None fields — never
+    an exception on a recording path."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.device import ProgramLedger
+
+    class NoMemoryCompiled:
+        def cost_analysis(self):
+            return [{"flops": 123.0, "bytes accessed": 41.0}]
+
+        def memory_analysis(self):
+            raise NotImplementedError("unsupported backend")
+
+    class BareCompiled:
+        def cost_analysis(self):
+            raise RuntimeError("no cost model")
+
+        def memory_analysis(self):
+            return None
+
+    ledger = ProgramLedger(peak_flops=1e12, emit_events=False)
+    entry = ledger.record_compiled("step", NoMemoryCompiled(), k=4)
+    assert entry.flops == 123.0 and entry.dispatch_flops == 492.0
+    assert entry.arithmetic_intensity == pytest.approx(3.0)
+    assert entry.hbm_peak_bytes is None and entry.temp_bytes is None
+    bare = ledger.record_compiled("other", BareCompiled())
+    assert bare.flops is None and bare.dispatch_flops is None
+    assert bare.hbm_peak_bytes is None
+    assert ledger.mfu_pct(10.0) is None  # no train entry -> no MFU claim
+    rows = ledger.table()
+    assert {row["name"] for row in rows} == {"step", "other"}
+
+
+def test_memory_stats_absent_on_cpu_degrades_to_none():
+    """CPU backends expose no ``memory_stats``: the sampler returns None
+    (not an empty crash), the heartbeat simply omits the memory field and
+    the growth detector is never fed."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import device as dev
+
+    assert jax.default_backend() == "cpu"
+    assert dev.sample_memory_stats() is None
+
+
+def test_memory_growth_detector_fires_on_monotonic_rise_only():
+    from howtotrainyourmamlpytorch_tpu.telemetry import MemoryGrowthDetector
+
+    gib = 1 << 30
+    det = MemoryGrowthDetector(consecutive=4, min_delta_bytes=256 << 20,
+                               min_frac=0.01)
+    # Noisy steady state: rises keep breaking -> never fires.
+    for value in (10, 11, 10, 11, 10, 11, 10, 11, 10, 11):
+        assert det.observe(value * gib) is None
+    # Monotonic climb: fires once the run + delta floors clear.
+    fired = None
+    for step in range(1, 10):
+        fired = fired or det.observe((11 + step) * gib)
+    assert fired is not None and fired["kind"] == "memory_growth"
+    assert fired["rise_bytes"] >= 256 << 20
+    # Re-armed: the very next sample cannot fire again without a new climb.
+    assert det.observe((22 * gib) - 1) is None
+
+
+def test_heartbeat_carries_watermarks_and_memory_growth_anomaly(
+    tmp_path, monkeypatch
+):
+    """On backends WITH memory_stats (faked here — CPU has none), the
+    heartbeat carries per-device watermarks, a ``memory`` event lands in
+    the JSONL per boundary, and a monotonic rise across boundaries emits
+    the typed ``memory_growth`` anomaly event."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import MemoryGrowthDetector
+    from howtotrainyourmamlpytorch_tpu.telemetry import device as dev
+
+    telemetry = TrainTelemetry(str(tmp_path), enabled=True)
+    telemetry.memory_growth = MemoryGrowthDetector(
+        consecutive=3, min_delta_bytes=1 << 20, min_frac=0.0
+    )
+    sample = {"n": 0}
+
+    def fake_stats():
+        sample["n"] += 1
+        return [{
+            "device": 0, "kind": "FakeTPU",
+            "bytes_in_use": sample["n"] * (64 << 20),
+            "peak_bytes_in_use": sample["n"] * (96 << 20),
+        }]
+
+    monkeypatch.setattr(dev, "sample_memory_stats", fake_stats)
+    with telemetry.activate():
+        for i in range(1, 7):
+            telemetry.record_dispatch(i, n_iters=1)
+            telemetry.boundary(i, 0.0, reason="log")
+    events = read_events(os.path.join(str(tmp_path), "telemetry.jsonl"))
+    memories = [e for e in events if e["type"] == "memory"]
+    assert memories and memories[-1]["bytes_in_use_total"] == 6 * (64 << 20)
+    growth = [
+        e for e in events
+        if e["type"] == "anomaly" and e.get("kind") == "memory_growth"
+    ]
+    assert growth, [e for e in events if e["type"] == "anomaly"]
+    assert growth[0]["rise_bytes"] > 0
+    hb = json.load(open(os.path.join(str(tmp_path), "status.json")))
+    assert hb["memory"][0]["bytes_in_use"] == 6 * (64 << 20)
+
+
+def test_oom_at_iter_writes_forensics_and_exits_registered_code(
+    dataset_env,
+):
+    """The OOM-forensics acceptance, chaos-style through the real
+    ExperimentBuilder: an injected RESOURCE_EXHAUSTED at a dispatch
+    boundary exits through the REGISTERED code with a complete
+    ``logs/oom_report.json`` (top programs by temp bytes, watermarks slot,
+    config levers), an ``oom`` telemetry event, and an audit row."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.device import OOM_EXIT_CODE
+
+    from test_faultinject import _builder, _exp_args
+
+    tmp = dataset_env
+    faultinject.activate(faultinject.FaultPlan(oom_at_iter=1))
+    builder = _builder(_exp_args(tmp))
+    with pytest.raises(SystemExit) as exits:
+        builder.run_experiment()
+    assert exits.value.code == OOM_EXIT_CODE == 77
+    assert any(e.startswith("oom:") for e in faultinject.events)
+    report_path = tmp / "exp" / "logs" / "oom_report.json"
+    assert report_path.exists()
+    report = json.load(open(report_path))
+    assert report["exit_code"] == OOM_EXIT_CODE
+    assert "RESOURCE_EXHAUSTED" in report["error"]
+    assert "top_programs_by_temp_bytes" in report
+    assert "memory_watermarks" in report  # None on CPU, key present
+    levers = report["config_levers"]
+    assert levers["batch_size"] is not None
+    assert "task_chunk" in levers and "iters_per_dispatch" in levers
+    events = read_events(str(tmp / "exp" / "logs" / "telemetry.jsonl"))
+    oom = next(e for e in events if e["type"] == "oom")
+    assert oom["code"] == OOM_EXIT_CODE
+    assert oom["report"] == "oom_report.json"
+    with open(tmp / "exp" / "logs" / "interruptions.csv") as f:
+        assert ",oom," in f.read().replace("\r", "")
+
+
+def test_serve_engine_ledger_rows_reach_metrics(tmp_path, compile_guard):
+    """The serve side of the plane: warmup ingests one ledger row per
+    compiled program (labels matching the compile table), /metrics gains
+    the per-bucket program gauges, and a traffic dispatch on the warmed
+    bucket mints NO new program signatures with the ledger active."""
+    from test_serve_runtime import episode, make_engine
+
+    with compile_guard() as guard:
+        engine = make_engine(meta_batch_size=2, max_wait_ms=0.0)
+        engine.warmup([(5, 1, 3)])
+        rows = engine.ledger.table()
+        assert {row["role"] for row in rows} == {
+            "serve_adapt", "serve_classify",
+        }
+        assert all(row["bucket"] == "5x1x3" for row in rows)
+        labels = {row["name"] for row in rows}
+        assert labels == set(engine.compile_table())
+        before = set(guard.signatures("serve_"))
+        ep = engine.prepare_episode(*episode(np.random.RandomState(0)))
+        engine.dispatch([ep])
+        assert set(guard.signatures("serve_")) == before  # no new sigs
+    text = engine.metrics.render_prometheus(
+        program_table=engine.ledger.table()
+    )
+    assert "maml_serve_program_flops" in text
+    assert 'bucket="5x1x3"' in text
+    snap = engine.metrics.snapshot(program_table=engine.ledger.table())
+    assert len(snap["programs"]) == len(rows)
+
+
+def test_report_device_section_renders_and_tolerates_empty_ledger(
+    tmp_path,
+):
+    """Report degradation contract: a JSONL with program_profile + memory
+    events renders the device section (programs table, MFU, watermarks);
+    a pre-ledger JSONL (no device events) summarizes with ``device: None``
+    and renders without crashing."""
+    sys.path.insert(0, REPO)
+    from tools.telemetry_report import render_text, summarize
+
+    log = EventLog(str(tmp_path / "telemetry.jsonl"))
+    prev = telemetry_events.install(log)
+    try:
+        telemetry_events.emit(
+            "program_profile", name="multi", role="train", k=25,
+            flops=2.0e6, dispatch_flops=5.0e7, bytes_accessed=1.0e6,
+            arithmetic_intensity=2.0, hbm_peak_bytes=123456,
+            temp_bytes=1000, bucket=None, device_kind="cpu",
+            peak_flops=1.974e14,
+        )
+        telemetry_events.emit(
+            "memory", iter=5,
+            devices=[{"device": 0, "bytes_in_use": 7, "peak_bytes_in_use": 9}],
+            bytes_in_use_total=7, peak_bytes_in_use_max=9,
+        )
+        telemetry_events.emit("step", iter=1, dispatch_id=1, k=1,
+                              step_s=0.5, data_wait_s=0.0,
+                              stage_wait_s=0.0, staged=False, device_s=0.5)
+    finally:
+        telemetry_events.install(prev)
+    log.flush()
+    summary = summarize(read_events(log.path))
+    device = summary["device"]
+    assert device is not None
+    assert device["programs"][0]["name"] == "multi"
+    assert device["programs"][0]["k"] == 25
+    # MFU from the JSONL alone: rate (2 iters/s) x flops / stamped peak.
+    assert device["mfu_pct"] == pytest.approx(
+        100.0 * 2.0 * 2.0e6 / 1.974e14, rel=1e-5
+    )
+    assert device["memory"]["bytes_in_use_total"] == 7
+    text = render_text(summary)
+    assert "device-resource ledger" in text
+    assert "windowed MFU" in text and "memory watermarks" in text
+    # program_profile/memory stay OUT of the generic event log section.
+    assert summary["event_counts"]["program_profile"] == 1
+    assert not [e for e in summary["events"]
+                if e["type"] in ("program_profile", "memory")]
+
+    # Empty-ledger rendering: a log with no device events at all.
+    bare = EventLog(str(tmp_path / "bare.jsonl"))
+    bare.emit("step", iter=1, dispatch_id=1, k=1, step_s=0.5,
+              data_wait_s=0.0, stage_wait_s=0.0, staged=False,
+              device_s=0.5)
+    bare.flush()
+    bare_summary = summarize(read_events(bare.path))
+    assert bare_summary["device"] is None
+    assert "device-resource ledger" not in render_text(bare_summary)

@@ -111,6 +111,8 @@ EXIT_CODE_REGISTRY = {
     3: "episode miner: nothing cleared the margin gate (no manifest)",
     75: "preemption requeue (EX_TEMPFAIL; resume on the same mesh)",
     76: "watchdog hang — requeue degraded (suspect the topology)",
+    77: "device OOM (RESOURCE_EXHAUSTED) — forensics in logs/"
+        "oom_report.json; do NOT requeue the same config",
     86: "serve replica fault-kill (injected worker death)",
 }
 

@@ -137,33 +137,40 @@ def main() -> None:
     ]
     epoch = 20  # steady-state variant: second order, past the MSL horizon
 
-    lowered = learner.lowered_train_iters(state, batches, epoch)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    # XLA cost analysis counts the K-scan BODY once (verified: identical for
-    # K=1/5/25; matches a hand count of one meta-iteration) — the reported
-    # numbers are already per-iteration. "bytes accessed" counts every
-    # logical op's operands/results, so under fusion it OVERSTATES true HBM
-    # traffic — the hbm-bound line below is an upper bound on memory time.
-    flops_iter = float(cost.get("flops", 0.0))
-    bytes_iter = float(cost.get("bytes accessed", 0.0))
+    # ONE accounting implementation (telemetry/device.py): the program
+    # ledger applies the scan-body-once rule with the learner's DECLARED
+    # dispatch multiplier K — the hand-rolled K-correction comment that
+    # used to live here is now code. The ledger's `flops` field is the
+    # per-iteration body cost; "bytes accessed" counts every logical op's
+    # operands/results, so under fusion it OVERSTATES true HBM traffic —
+    # the hbm-bound line below is an upper bound on memory time.
+    from howtotrainyourmamlpytorch_tpu.telemetry.device import (
+        ProgramLedger,
+        record_train_program,
+    )
+
+    ledger = ProgramLedger(
+        peak_flops=V5E_PEAK_F32MULT_FLOPS, emit_events=False
+    )
+    entry = record_train_program(ledger, learner, state, batches, epoch)
+    flops_iter = float(entry.flops or 0.0)
+    bytes_iter = float(entry.bytes_accessed or 0.0)
     print(f"flops/iter          : {flops_iter:.3e}")
     print(f"hbm bytes/iter      : {bytes_iter:.3e} (fusion-overcounted upper bound)")
-    # Bytes-accessed split (operand reads vs output writes) straight from
-    # cost_analysis, so traffic-bound claims — and what each lever
+    print(f"dispatch multiplier : K={entry.k} (declared; "
+          f"{entry.dispatch_flops or 0.0:.3e} flops/dispatch)")
+    if entry.hbm_peak_bytes is not None:
+        print(f"hbm peak (static)   : {entry.hbm_peak_bytes:.3e} "
+              f"(args {entry.argument_bytes:.3e} + out "
+              f"{entry.output_size_bytes:.3e} + temps "
+              f"{entry.temp_bytes:.3e})")
+    # Bytes-accessed split (operand reads vs output writes) from the same
+    # ledger row, so traffic-bound claims — and what each lever
     # (--lane-pad / --compute-dtype / --task-chunk) does to them — are
     # attributable without a profiler trace. Keys are backend-dependent;
     # absent keys print as n/a rather than zero.
-    operand_bytes = sum(
-        float(v) for k, v in cost.items()
-        if isinstance(k, str) and k.startswith("bytes accessed operand")
-    )
-    output_bytes = sum(
-        float(v) for k, v in cost.items()
-        if isinstance(k, str) and k.startswith("bytes accessed output")
-    )
+    operand_bytes = entry.operand_bytes or 0.0
+    output_bytes = entry.output_bytes or 0.0
     if operand_bytes or output_bytes:
         print(f"  operand reads     : {operand_bytes:.3e} "
               f"({100 * operand_bytes / max(bytes_iter, 1.0):.0f}%)")

@@ -1,7 +1,10 @@
 """Telemetry report: render a run's ``logs/telemetry.jsonl`` + overhead bench.
 
 Report mode — step-time breakdown table (data-wait vs device dispatch vs
-host-sync), XLA compile timeline, checkpoint/sentinel/preemption event log::
+host-sync), XLA compile timeline, the device-resource ledger section
+(per-program FLOPs/bytes/arithmetic-intensity from ``program_profile``
+events, windowed MFU, memory watermarks — absent from pre-ledger logs and
+rendered gracefully either way), checkpoint/sentinel/preemption event log::
 
     python tools/telemetry_report.py <experiment-dir | telemetry.jsonl>
     python tools/telemetry_report.py <run> --json     # machine-readable
@@ -127,8 +130,11 @@ def summarize(events: list[dict]) -> dict:
             **{k: v for k, v in e.items() if k not in ("t", "signature")},
         }
         for e in events
-        if e.get("type") not in ("step", "compile", "serve_compile")
+        if e.get("type") not in (
+            "step", "compile", "serve_compile", "program_profile", "memory",
+        )
     ]
+    device = _device_section(events, per_iter["step"])
     counts: dict[str, int] = {}
     for e in events:
         counts[e.get("type", "?")] = counts.get(e.get("type", "?"), 0) + 1
@@ -158,9 +164,66 @@ def summarize(events: list[dict]) -> dict:
         "process_indices": process_indices,
         "breakdown": breakdown,
         "compiles": compiles,
+        "device": device,
         "events": log,
         "event_counts": counts,
     }
+
+
+def _device_section(events: list[dict], step_samples_s: list[float]):
+    """The device-resource plane of a run's JSONL: the per-program ledger
+    rows (``program_profile`` events — newest per program name wins), the
+    last memory watermarks, and the run-level MFU derived from the train
+    program's K-corrected FLOPs × the measured iteration rate against the
+    peak stamped on the event. ``None`` when the log predates the ledger
+    (or telemetry ran without it) — the report renders fine either way,
+    the empty-ledger degradation contract."""
+    profiles: dict[str, dict] = {}
+    for e in events:
+        if e.get("type") == "program_profile":
+            profiles[str(e.get("name", "?"))] = e
+    memories = [e for e in events if e.get("type") == "memory"]
+    if not profiles and not memories:
+        return None
+    section: dict = {
+        "programs": [
+            {
+                key: e.get(key)
+                for key in (
+                    "name", "role", "k", "flops", "dispatch_flops",
+                    "bytes_accessed", "arithmetic_intensity",
+                    "hbm_peak_bytes", "temp_bytes", "bucket",
+                    "device_kind",
+                )
+            }
+            for e in sorted(
+                profiles.values(),
+                key=lambda p: (str(p.get("role")), str(p.get("name"))),
+            )
+        ]
+    }
+    trains = [e for e in profiles.values() if e.get("role") == "train"]
+    if trains and step_samples_s and sum(step_samples_s) > 0:
+        train = max(trains, key=lambda e: float(e.get("t", 0.0)))
+        flops = train.get("flops")
+        peak = train.get("peak_flops")
+        if flops and peak:
+            rate = len(step_samples_s) / sum(step_samples_s)
+            # Significant digits, not decimal places: off-TPU MFU sits at
+            # 1e-4..1e-6 % and must not round to zero.
+            section["mfu_pct"] = float(
+                f"{100.0 * rate * flops / peak:.6g}"
+            )
+            section["peak_flops"] = peak
+    if memories:
+        last = memories[-1]
+        section["memory"] = {
+            "devices": last.get("devices"),
+            "bytes_in_use_total": last.get("bytes_in_use_total"),
+            "peak_bytes_in_use_max": last.get("peak_bytes_in_use_max"),
+            "samples": len(memories),
+        }
+    return section
 
 
 def render_text(summary: dict) -> str:
@@ -195,6 +258,46 @@ def render_text(summary: dict) -> str:
     lines.append(f"compile timeline ({len(summary['compiles'])} events)")
     for c in summary["compiles"]:
         lines.append(f"  +{c['t_rel_s']:>9.3f}s  {c['kind']:<14} {c['name']}")
+    device = summary.get("device")
+    if device:
+        lines.append("")
+        lines.append(
+            f"device-resource ledger ({len(device['programs'])} program(s))"
+        )
+        dheader = (
+            f"  {'program':<22} {'role':<14} {'K':>4} {'flops/iter':>12} "
+            f"{'bytes/iter':>12} {'flops/B':>8} {'hbm peak':>12}"
+        )
+        lines.append(dheader)
+        lines.append("  " + "-" * (len(dheader) - 2))
+
+        def num(value, fmt="{:.3e}"):
+            return "—" if value is None else fmt.format(value)
+
+        for row in device["programs"]:
+            lines.append(
+                f"  {str(row['name'])[:22]:<22} {str(row['role']):<14} "
+                f"{row.get('k') or 1:>4} {num(row.get('flops')):>12} "
+                f"{num(row.get('bytes_accessed')):>12} "
+                f"{num(row.get('arithmetic_intensity'), '{:.2f}'):>8} "
+                f"{num(row.get('hbm_peak_bytes')):>12}"
+            )
+        if device.get("mfu_pct") is not None:
+            lines.append(
+                f"  windowed MFU: {device['mfu_pct']:.4g}% of peak "
+                f"{device['peak_flops']:.3e} FLOP/s"
+            )
+        memory = device.get("memory")
+        if memory and memory.get("devices"):
+            lines.append(
+                f"  memory watermarks ({memory['samples']} sample(s)): "
+                + ", ".join(
+                    f"dev{d.get('device')} in_use="
+                    f"{d.get('bytes_in_use', 0):.3e} "
+                    f"peak={d.get('peak_bytes_in_use', 0):.3e}"
+                    for d in memory["devices"]
+                )
+            )
     lines.append("")
     lines.append(f"event log ({len(summary['events'])} events)")
     for e in summary["events"]:
@@ -251,6 +354,10 @@ def fleet_summarize(paths: list[str], since: float | None = None) -> dict:
 
     lanes: dict[int, dict[str, list[float]]] = {}
     dispatches: dict[object, dict[int, list[dict]]] = {}
+    # Device-plane ledger rows, per (rank, program) — a fleet merge shows
+    # every rank's compiled-program costs side by side (identical on a
+    # healthy lockstep fleet; a divergent row IS the finding).
+    programs: dict[tuple[int, str], dict] = {}
     timeline: collections.deque = collections.deque(
         maxlen=_JSON_TIMELINE_LIMIT
     )
@@ -299,6 +406,17 @@ def fleet_summarize(paths: list[str], since: float | None = None) -> dict:
                     "step_s": float(event["step_s"]),
                     "device_s": float(event.get("device_s", 0.0)),
                 })
+        elif etype == "program_profile":
+            programs[(rank, str(event.get("name", "?")))] = {
+                "rank": rank,
+                **{
+                    key: event.get(key)
+                    for key in (
+                        "name", "role", "k", "flops", "dispatch_flops",
+                        "arithmetic_intensity", "hbm_peak_bytes", "bucket",
+                    )
+                },
+            }
         else:
             timeline.append(event)
             timeline_total += 1
@@ -359,6 +477,11 @@ def fleet_summarize(paths: list[str], since: float | None = None) -> dict:
         # single timeline rather than a coincidence of files.
         "trace_consistent": len(trace_ids) <= 1,
         "lanes": lane_summaries,
+        "programs": [
+            # Plain tuple sort: (rank, name) — str() keys would order
+            # rank 10 before rank 2 on wide fleets.
+            programs[key] for key in sorted(programs)
+        ],
         "dispatch_skew": skew_stats,
         "slowest_rank_dispatches": {
             str(rank): n for rank, n in sorted(slowest_counts.items())
@@ -422,6 +545,19 @@ def render_fleet_text(summary: dict) -> str:
                 f"  {rank:<5} {name:<12} {row['count']:>7} "
                 f"{row['p50_ms']:>10.3f} {row['p95_ms']:>10.3f} "
                 f"{row['mean_ms']:>10.3f} {row['total_s']:>9.2f}"
+            )
+    if summary.get("programs"):
+        lines.append("")
+        lines.append(
+            f"device-resource ledger ({len(summary['programs'])} "
+            "program row(s) across ranks)"
+        )
+        for row in summary["programs"]:
+            flops = row.get("flops")
+            lines.append(
+                f"  r{row['rank']}  {str(row.get('name')):<22} "
+                f"{str(row.get('role')):<12} K={row.get('k') or 1:<4} "
+                + ("flops/iter %.3e" % flops if flops else "flops n/a")
             )
     skew = summary["dispatch_skew"]
     lines.append("")
