@@ -50,7 +50,7 @@ BASELINE_META_ITERS_PER_S = 0.55
 EMITTED_KEYS = (
     "metric", "value", "unit", "vs_baseline",
     "peak_meta_iters_per_s", "sustained_meta_iters_per_s", "mfu",
-    "mfu_pct", "hbm_peak_bytes",
+    "mfu_pct", "hbm_peak_bytes", "comm_bytes_per_iter",
     "bf16_meta_iters_per_s", "f32_wire_meta_iters_per_s",
     "real_data_meta_iters_per_s", "real_data_vs_baseline",
     "real_data_k25_meta_iters_per_s",
@@ -1257,6 +1257,10 @@ def main() -> None:
     entry = _train_program_entry(learner, state_template, batches, epoch)
     flops = entry.flops if entry is not None else None
     hbm_peak_bytes = entry.hbm_peak_bytes if entry is not None else None
+    # Collective traffic of the compiled train program per meta-iteration
+    # (ledger comm column, same cache-hit lowering): the fused-all-reduce
+    # work's keep gate — single-process runs legitimately read 0.
+    comm_bytes_per_iter = entry.comm_bytes if entry is not None else None
     if flops:
         mfu = value * flops / chip_peak_flops
 
@@ -1465,6 +1469,7 @@ def main() -> None:
                     float(f"{100.0 * mfu:.6g}") if mfu is not None else None
                 ),
                 "hbm_peak_bytes": hbm_peak_bytes,
+                "comm_bytes_per_iter": comm_bytes_per_iter,
                 "bf16_meta_iters_per_s": round(bf16_value, 4),
                 "f32_wire_meta_iters_per_s": round(f32_value, 4),
                 "real_data_meta_iters_per_s": (

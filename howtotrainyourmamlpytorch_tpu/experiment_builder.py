@@ -931,14 +931,6 @@ class ExperimentBuilder:
                 self.train_state, data_batch, epoch=epoch_idx
             )
             self._record_dispatch(upto_iter=current_iter + 1)
-            # Device-resource ledger: a compile event during the dispatch
-            # above armed the pending flag; resolve it ONCE via the
-            # learner's AOT hook (cache-hit compile — zero new XLA
-            # compiles, zero device reads; no-op in steady state).
-            self.telemetry.ingest_train_program(
-                self.model, self.train_state, data_batch, epoch_idx,
-                single=True,
-            )
             # Metrics are device scalars; they are appended UNREAD so the
             # host never blocks on the step it just dispatched (the summary
             # forces them at epoch boundaries). Reading per-iteration here
@@ -964,6 +956,18 @@ class ExperimentBuilder:
                     flush=True,
                 )
                 self.telemetry.boundary(current_iter, sync_s, reason="log")
+        # Device-resource ledger: a compile event during the dispatch
+        # above armed the pending flag; resolve it ONCE via the learner's
+        # AOT hook (cache-hit compile — zero new XLA compiles, zero
+        # device reads; no-op in steady state). OUTSIDE the armed window:
+        # this is host-side compile-cache work, not a device dispatch —
+        # a wedged runtime can't park here, and folding its cold-start
+        # cost into the compile-bearing first window nearly doubled that
+        # window against the watchdog's minimum deadline.
+        self.telemetry.ingest_train_program(
+            self.model, self.train_state, data_batch, epoch_idx,
+            single=True,
+        )
         return total_losses, current_iter
 
     def train_iteration_multi(self, samples, epoch_idx, total_losses, current_iter):
@@ -986,12 +990,6 @@ class ExperimentBuilder:
                 self.train_state, batches, epoch=epoch_idx
             )
             self._record_dispatch(n_iters, upto_iter=current_iter + n_iters)
-            # Ledger ingest for the K-scan program (see train_iteration):
-            # the learner's declared K multiplier rides the same hook.
-            self.telemetry.ingest_train_program(
-                self.model, self.train_state, batches, epoch_idx,
-                single=False,
-            )
             for key, value in losses.items():
                 total_losses.setdefault(key, []).append(value)
             current_iter += n_iters
@@ -1006,6 +1004,13 @@ class ExperimentBuilder:
                     flush=True,
                 )
                 self.telemetry.boundary(current_iter, sync_s, reason="log")
+        # Ledger ingest for the K-scan program — outside the armed window
+        # for the same reason as train_iteration's (host-side AOT work,
+        # not hang-detectable device dispatch).
+        self.telemetry.ingest_train_program(
+            self.model, self.train_state, batches, epoch_idx,
+            single=False,
+        )
         return total_losses, current_iter
 
     def _stage_eval_batch(self, data_batch):

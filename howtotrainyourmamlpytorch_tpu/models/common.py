@@ -476,6 +476,14 @@ class CheckpointableLearner:
     #: replicated on the first dispatch (and defeat donation).
     supports_model_sharding = False
 
+    #: Declared per-meta-iteration EXPLICIT-collective ceiling for this
+    #: learner's hot programs — what graftlint's ``collective-budget``
+    #: rule enforces on the traced jaxpr (tools/graftlint/programs.py).
+    #: The baselines reduce through GSPMD's implicit layout-driven
+    #: collectives (none appear in their jaxprs), so their budget is 0;
+    #: MAML's fused dp step declares its own (models/maml.py).
+    collective_budget = 0
+
     def state_shardings(self, state):
         """``NamedSharding`` tree for a full train state under this
         learner's mesh (``parallel/sharding.state_shardings`` rule tables:
@@ -652,3 +660,216 @@ class CheckpointableLearner:
         serve-time state beyond the checkpoint prefix override this (GD
         attaches the epoch-schedule fine-tune lr)."""
         return self._load_inference_prefix(filepath)
+
+
+# ---------------------------------------------------------------------------
+# Program registry (ISSUE 17) — the learner-side table graftlint's
+# --programs pass traces
+# ---------------------------------------------------------------------------
+
+#: Static name table of every program the registry CAN build — a pure
+#: literal so jax-free consumers (tools/bench_judge.py's program-derived
+#: stale-gate check) can AST-parse it exactly like bench.EMITTED_KEYS.
+#: tests/test_graftlint_programs.py pins it against the built registry.
+PROGRAM_REGISTRY_NAMES = (
+    "maml/train_step",
+    "maml/train_multi",
+    "maml/train_step_bf16",
+    "maml/train_step_mp",
+    "maml/eval_step",
+    "maml/serve_adapt",
+    "gd/train_step",
+    "matching/train_step",
+)
+
+
+class ProgramSpec(NamedTuple):
+    """One registered step/serve program: everything the IR-level lint
+    pass (tools/graftlint/programs.py) needs to trace it abstractly and
+    judge its declared contracts — no devices, no executions.
+
+    ``build`` returns ``(fn, args)``: a traceable callable (the learner's
+    own jit-wrapped step where one exists) plus example arguments;
+    ``jax.make_jaxpr(fn)(*args)`` is the analysis input, ``fn.lower``
+    (jitted programs only) feeds the donation check. ``k`` is the
+    DECLARED dispatch multiplier (:func:`dispatch_multiplier` semantics:
+    scan bodies count once, per-meta-iteration contracts divide by K).
+    ``source``/``line`` anchor violations to the code that declares the
+    program."""
+
+    name: str
+    source: str
+    build: Any
+    collective_budget: int = 0
+    k: int = 1
+    compute_dtype: str = "float32"
+    donate: bool = False
+    line: int = 1
+
+
+def _tiny_backbone_kwargs():
+    """The conftest-probe shapes: small enough that building a learner and
+    an init state is milliseconds, structurally identical to the real
+    nets (conv stages + per-step BN + linear head)."""
+    return dict(
+        num_stages=2, num_filters=4, per_step_bn_statistics=True,
+        num_steps=2, num_classes=5, image_height=8, image_width=8,
+    )
+
+
+def _tiny_episode_batch(n_tasks=2):
+    rng = np.random.RandomState(0)
+    xs = rng.rand(n_tasks, 5, 1, 1, 8, 8).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (n_tasks, 1, 1))
+    return (xs, xs.copy(), ys, ys.copy())
+
+
+def registered_programs() -> "list[ProgramSpec]":
+    """Builds the live program table — every registered step/serve
+    program of the three learner families, on the mesh variants this
+    process's device count allows (dp needs 2, the mp layout 4). Lazy:
+    learners are only imported (and tiny instances only built) when
+    called, so jax-free consumers can import this module without paying
+    for it."""
+    from .gradient_descent import GradientDescentLearner
+    from .maml import BackboneConfig, MAMLConfig, MAMLFewShotLearner
+    from .matching_nets import MatchingNetsLearner
+
+    n_devices = len(jax.devices())
+
+    def maml_cfg(**overrides):
+        return MAMLConfig(
+            backbone=BackboneConfig(**_tiny_backbone_kwargs()),
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+            **overrides,
+        )
+
+    def dp_mesh():
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh(jax.devices()[:2], data_parallel=2, model_parallel=1)
+
+    def maml_learner(**overrides):
+        mesh = dp_mesh() if n_devices >= 2 else None
+        return MAMLFewShotLearner(maml_cfg(**overrides), mesh=mesh)
+
+    def maml_train(**overrides):
+        def build():
+            learner = maml_learner(**overrides)
+            state = learner.init_state(jax.random.PRNGKey(0))
+            batch = learner._prepare_batch(_tiny_episode_batch())
+            importance = jnp.asarray(learner._train_importance(100))
+            fn = learner._get_train_step(second_order=True, final_only=True)
+            return fn, (state, batch, importance)
+
+        return build
+
+    def maml_train_multi(k):
+        def build():
+            learner = maml_learner()
+            state = learner.init_state(jax.random.PRNGKey(0))
+            prepared = [
+                learner._prepare_batch(_tiny_episode_batch())
+                for _ in range(k)
+            ]
+            batches = tuple(
+                np.stack([p[i] for p in prepared])
+                for i in range(len(prepared[0]))
+            )
+            importance = jnp.asarray(learner._train_importance(100))
+            fn = learner._get_multi_train_step(
+                second_order=True, final_only=True
+            )
+            return fn, (state, batches, importance)
+
+        return build
+
+    def maml_train_mp():
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(
+            jax.devices()[:4], data_parallel=2, model_parallel=2
+        )
+        learner = MAMLFewShotLearner(maml_cfg(), mesh=mesh)
+        state = learner.init_state(jax.random.PRNGKey(0))
+        batch = learner._prepare_batch(_tiny_episode_batch())
+        importance = jnp.asarray(learner._train_importance(100))
+        fn = learner._get_train_step(second_order=True, final_only=True)
+        return fn, (state, batch, importance)
+
+    def maml_eval():
+        learner = maml_learner()
+        state = learner.init_state(jax.random.PRNGKey(0))
+        batch = learner._prepare_batch(_tiny_episode_batch())
+        importance = jnp.asarray(learner._eval_importance())
+        fn = learner._get_eval_step(final_only=True)
+        return fn, (state, batch, importance)
+
+    def maml_serve():
+        learner = MAMLFewShotLearner(maml_cfg())
+        istate = learner.init_inference_state(jax.random.PRNGKey(0))
+        xs, _, ys, _ = _tiny_episode_batch()
+        # One task's flat support set, the engine's wire shape:
+        # (S, C, H, W) images and (S,) int32 labels (serve/engine.py).
+        x_support = jnp.asarray(xs[0]).reshape(-1, 1, 8, 8)
+        y_support = jnp.asarray(ys[0], jnp.int32).reshape(-1)
+        fn = jax.jit(learner.serve_adapt)
+        return fn, (istate, x_support, y_support)
+
+    def baseline_train(learner_cls):
+        def build():
+            learner = learner_cls(maml_cfg())
+            state = learner.init_state(jax.random.PRNGKey(0))
+            batch = prepare_batch(_tiny_episode_batch())
+            return learner._train_step, (state, batch)
+
+        return build
+
+    maml_src = "howtotrainyourmamlpytorch_tpu/models/maml.py"
+    budget = MAMLFewShotLearner.collective_budget
+    programs = [
+        ProgramSpec(
+            name="maml/train_step", source=maml_src, build=maml_train(),
+            collective_budget=budget, donate=True,
+        ),
+        ProgramSpec(
+            name="maml/train_multi", source=maml_src,
+            build=maml_train_multi(3), collective_budget=budget, k=3,
+            donate=True,
+        ),
+        ProgramSpec(
+            name="maml/train_step_bf16", source=maml_src,
+            build=maml_train(compute_dtype="bfloat16"),
+            collective_budget=budget, compute_dtype="bfloat16", donate=True,
+        ),
+        ProgramSpec(
+            name="maml/eval_step", source=maml_src, build=maml_eval,
+            collective_budget=budget,
+        ),
+        ProgramSpec(
+            name="maml/serve_adapt", source=maml_src, build=maml_serve,
+            collective_budget=budget,
+        ),
+        ProgramSpec(
+            name="gd/train_step",
+            source="howtotrainyourmamlpytorch_tpu/models/gradient_descent.py",
+            build=baseline_train(GradientDescentLearner),
+            collective_budget=GradientDescentLearner.collective_budget,
+            donate=True,
+        ),
+        ProgramSpec(
+            name="matching/train_step",
+            source="howtotrainyourmamlpytorch_tpu/models/matching_nets.py",
+            build=baseline_train(MatchingNetsLearner),
+            collective_budget=MatchingNetsLearner.collective_budget,
+            donate=True,
+        ),
+    ]
+    if n_devices >= 4:
+        programs.insert(3, ProgramSpec(
+            name="maml/train_step_mp", source=maml_src, build=maml_train_mp,
+            collective_budget=budget, donate=True,
+        ))
+    assert all(p.name in PROGRAM_REGISTRY_NAMES for p in programs)
+    return programs

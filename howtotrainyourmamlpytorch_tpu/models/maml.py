@@ -119,6 +119,15 @@ class MAMLConfig:
     # reassociation vs the full vmap: the per-task math is identical, only
     # the outer-grad accumulation order changes.
     task_chunk: int = 0
+    # Cross-replica meta-gradient reduction on dp meshes (ISSUE 17,
+    # parallel/collectives.py): "bucketed" all-reduces ONE flat buffer per
+    # gradient dtype inside the jitted step (collective count == dtype
+    # count, within the learner's declared collective_budget — the
+    # graftlint collective-budget rule pins this); "per_leaf" is the
+    # ~147-collective storm form, kept only so regression tests can
+    # re-seed the red finding. Leaf values are bit-identical between the
+    # two (same elementwise sums, no reassociation).
+    collective_fusion: str = "bucketed"
     # uint8 image wire format (models/common.WireCodec): 4x less host->device
     # transfer bandwidth AND 4x slower axon-tunnel staging-buffer leak
     # (PERF_NOTES.md), bit-exact for the datasets that opt in.
@@ -169,6 +178,11 @@ class MAMLConfig:
         if self.task_chunk < 0:
             raise ValueError(
                 f"task_chunk must be >= 0, got {self.task_chunk}"
+            )
+        if self.collective_fusion not in ("bucketed", "per_leaf"):
+            raise ValueError(
+                "collective_fusion must be bucketed | per_leaf, got"
+                f" {self.collective_fusion!r}"
             )
         if self.compute_dtype not in ("float32", "bfloat16"):
             # The dtype property maps any non-"bfloat16" value to f32, so
@@ -245,6 +259,14 @@ class MAMLFewShotLearner(CheckpointableLearner):
     #: program — see __init__), so its state may carry MP_STATE_RULES.
     supports_model_sharding = True
 
+    #: Declared per-meta-iteration collective ceiling for the dp train
+    #: step (graftlint's collective-budget rule reads this): the fused
+    #: reduction needs one all-reduce per gradient dtype bucket plus the
+    #: loss/accuracy/BN sidecar — four covers every shipped config with
+    #: headroom, against the ~147 per-leaf storm it replaced
+    #: (PERF_NOTES.md "Collective storm flattened").
+    collective_budget = 4
+
     def __init__(self, cfg: MAMLConfig, mesh: jax.sharding.Mesh | None = None):
         self.cfg = cfg
         self.backbone = build_backbone(cfg.backbone)
@@ -262,6 +284,11 @@ class MAMLFewShotLearner(CheckpointableLearner):
         # the chunked scan form (scan axis replicated, chunk axis over
         # 'dp') — see _meta_loss and parallel/sharding.
         self._chunk_sharding = None
+        # dp-only meshes take the EXPLICIT fused-collective train step
+        # (shard_map + parallel/collectives.fused_psum): the mesh data
+        # axis name, or None off-mesh / on mp meshes (where GSPMD's
+        # arg-driven layout owns the reduction).
+        self._dp_axis: str | None = None
         if mesh is not None:
             from ..parallel.mesh import DEFAULT_MODEL_AXIS, mp_grad_anchor
             from ..parallel.sharding import batch_sharding_spec, guard_task_chunk
@@ -284,6 +311,9 @@ class MAMLFewShotLearner(CheckpointableLearner):
                 # the donated input state's layout, so donation holds on
                 # mesh runs; eval logits stay task-sharded, gathered only
                 # by the caller's host fetch).
+                from ..parallel.mesh import DEFAULT_DATA_AXIS
+
+                self._dp_axis = DEFAULT_DATA_AXIS
                 rep = replicated(mesh)
                 dp_batch = batch_sharding_spec(mesh)
                 if cfg.task_chunk > 0:
@@ -759,7 +789,14 @@ class MAMLFewShotLearner(CheckpointableLearner):
         pred_step: int | None = None,
         final_only: bool = False,
         outer_grad: bool = True,
+        task_chunk: int | None = None,
+        constrain_chunks: bool = True,
     ):
+        # ``task_chunk`` overrides cfg.task_chunk (the fused dp step passes
+        # the per-shard chunk — cfg.task_chunk / dp — because inside the
+        # shard_map-manual region only the local task slice exists);
+        # ``constrain_chunks=False`` likewise drops the mesh-axis layout
+        # constraint, which is illegal inside a manual region.
         # (B, N*K, C, H, W), ..., (B, N*K), (B, N*T); train batches of a
         # device_augment config carry a trailing per-task aug operand.
         xs, xt, ys, yt, *aug = batch
@@ -778,7 +815,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
             in_axes=(None, None, None, 0, 0, 0, 0, None, aug_axis),
         )
         num_tasks = xs.shape[0]
-        chunk = self.cfg.task_chunk
+        chunk = self.cfg.task_chunk if task_chunk is None else task_chunk
         if 0 < chunk < num_tasks:
             # Task-axis memory policy (--task_chunk): scan chunk-sized
             # slices of the task axis through the SAME vmapped program
@@ -798,7 +835,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
 
             def to_chunks(arr):
                 arr = arr.reshape((n_chunks, chunk) + arr.shape[1:])
-                if self._chunk_sharding is not None:
+                if constrain_chunks and self._chunk_sharding is not None:
                     arr = jax.lax.with_sharding_constraint(
                         arr, self._chunk_sharding
                     )
@@ -831,27 +868,103 @@ class MAMLFewShotLearner(CheckpointableLearner):
         # Mean over tasks (few_shot_learning_system.py:164)
         return jnp.mean(weighted), aux
 
+    def _meta_grads(self, state: TrainState, batch, importance,
+                    *, second_order, final_only):
+        """``(loss, accuracy_mean, bn_state_mean, grads)`` of one meta-step
+        — the reduction seam between the per-task math and the optimizer.
+
+        Off-mesh and on mp meshes this is plain ``value_and_grad`` (the mp
+        reduction is GSPMD's, driven by the caller's theta layout). On dp
+        meshes the whole computation runs inside ``shard_map`` over the
+        data axis and the cross-replica reduction is EXPLICIT:
+        ``parallel/collectives.fused_psum`` all-reduces the meta-grads as
+        one flat buffer per dtype (plus one sidecar bucket for loss/
+        accuracy/BN), so the per-program collective count is the dtype
+        count — not the ~147 per-leaf storm GSPMD emitted (ROADMAP item 1;
+        graftlint's collective-budget rule pins the declared ceiling).
+        Every shard contributes ``local_mean x local/global`` terms, so
+        leaf values match the global task mean exactly up to the same
+        reassociation GSPMD's tree reduction performs."""
+        outer = {"theta": state.theta, "lslr": state.lslr}
+        num_steps = self.cfg.number_of_training_steps_per_iter
+        if self._dp_axis is None:
+            (loss, aux), grads = jax.value_and_grad(
+                self._meta_loss, has_aux=True
+            )(
+                outer, state.bn_state, batch, importance,
+                num_steps, second_order, None, final_only,
+            )
+            if self._inner_grad_anchor is not None:
+                # mp meshes: the outer grads feed Adam updates of
+                # mp-sharded theta; without the anchor that layout
+                # back-propagates into the meta-gradient transpose convs
+                # and trips the same GSPMD CHECK (see
+                # parallel/mesh.mp_grad_anchor).
+                grads = self._inner_grad_anchor(grads)
+            accuracy_mean = jnp.mean(aux["accuracy"])
+            bn_state = jax.tree.map(
+                lambda s: jnp.mean(s, axis=0), aux["bn_state"]
+            )
+            return loss, accuracy_mean, bn_state, grads
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.collectives import fused_psum, per_leaf_psum
+
+        axis = self._dp_axis
+        dp = self.mesh.shape[axis]
+        reduce_fn = (
+            fused_psum if self.cfg.collective_fusion == "bucketed"
+            else per_leaf_psum
+        )
+        # Per-shard chunk: guard_task_chunk (construction time) pinned
+        # cfg.task_chunk % dp == 0, so the local scan sees chunk/dp tasks.
+        local_chunk = self.cfg.task_chunk // dp if self.cfg.task_chunk else 0
+
+        def shard_fn(outer, bn_state, batch, importance):
+            def local_loss(outer_):
+                loss, aux = self._meta_loss(
+                    outer_, bn_state, batch, importance,
+                    num_steps, second_order, None, final_only,
+                    task_chunk=local_chunk, constrain_chunks=False,
+                )
+                # local task mean / dp: the psum over equal shards is the
+                # exact global task mean (batch divisibility over dp is
+                # the mesh data plane's standing contract).
+                return loss / dp, aux
+
+            (loss_part, aux), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(outer)
+            acc_part = jnp.mean(aux["accuracy"]) / dp
+            bn_part = jax.tree.map(
+                lambda s: jnp.mean(s, axis=0) / dp, aux["bn_state"]
+            )
+            return reduce_fn((loss_part, acc_part, bn_part, grads), axis)
+
+        return shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(axis), P()),
+            out_specs=P(),
+            check_rep=False,
+        )(outer, state.bn_state, batch, importance)
+
     def _train_step(
         self, state: TrainState, batch, importance, *, second_order, final_only=False
     ):
         outer = {"theta": state.theta, "lslr": state.lslr}
-        (loss, aux), grads = jax.value_and_grad(self._meta_loss, has_aux=True)(
-            outer, state.bn_state, batch, importance,
-            self.cfg.number_of_training_steps_per_iter, second_order,
-            None, final_only,
+        loss, accuracy_mean, bn_state, grads = self._meta_grads(
+            state, batch, importance,
+            second_order=second_order, final_only=final_only,
         )
-        if self._inner_grad_anchor is not None:
-            # mp meshes: the outer grads feed Adam updates of mp-sharded
-            # theta; without the anchor that layout back-propagates into the
-            # meta-gradient transpose convs and trips the same GSPMD CHECK
-            # (see parallel/mesh.mp_grad_anchor).
-            grads = self._inner_grad_anchor(grads)
         updates, opt_state = self.tx.update(grads, state.opt_state, outer)
         outer = optax.apply_updates(outer, updates)
-        # Running stats evolved per task in parallel; mean-reduce across tasks.
-        # (Sequential accumulation in the reference is incidental statefulness
-        # with no effect on any output — see ops/norm.py.)
-        bn_state = jax.tree.map(lambda s: jnp.mean(s, axis=0), aux["bn_state"])
+        # bn_state: running stats evolved per task in parallel, mean-reduced
+        # across tasks by _meta_grads. (Sequential accumulation in the
+        # reference is incidental statefulness with no effect on any
+        # output — see ops/norm.py.)
         new_state = TrainState(
             theta=outer["theta"],
             lslr=outer["lslr"],
@@ -868,7 +981,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
             self.cfg.skip_nonfinite_updates, nonfinite, new_state, state
         )
         metrics = dict(
-            loss=loss, accuracy=jnp.mean(aux["accuracy"]), nonfinite=nonfinite
+            loss=loss, accuracy=accuracy_mean, nonfinite=nonfinite
         )
         return new_state, metrics
 

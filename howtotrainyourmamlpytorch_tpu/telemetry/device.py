@@ -41,6 +41,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -160,6 +161,8 @@ class ProgramEntry:
     temp_bytes: int | None = None
     generated_code_bytes: int | None = None
     hbm_peak_bytes: int | None = None  # argument + output + temp
+    collective_count: int | None = None  # cross-replica ops per iteration
+    comm_bytes: int | None = None  # bytes those collectives move per iter
     device_kind: str = ""
     note: str = ""
     t: float = 0.0
@@ -224,6 +227,62 @@ def analyze_memory(compiled) -> dict:
         )
     except (AttributeError, TypeError, ValueError):
         return {key: None for key in out}
+    return out
+
+
+#: HLO collective ops whose result lines the comm column counts. The
+#: async ``-start`` forms are folded into the base op (the ``-done`` half
+#: moves no new bytes and is not in this set).
+_HLO_COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute",
+)
+
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s+(?P<shape>\([^)]*\)|\S+)\s+(?:"
+    + "|".join(_HLO_COLLECTIVE_OPS)
+    + r")(?:-start)?\("
+)
+
+_HLO_SHAPE_TOKEN_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+def analyze_comm(compiled) -> dict:
+    """Collective traffic of a compiled program, from its optimized HLO
+    text: how many cross-replica ops one iteration dispatches and how
+    many bytes they move (the op RESULT shapes, summed). Reading the
+    post-optimization module catches GSPMD-inserted collectives the
+    jaxpr never shows — the runtime twin of graftlint's
+    ``collective-budget`` rule. Per-ITERATION like every other ledger
+    column: a ``lax.scan`` body appears once in the HLO ``while`` body.
+    Degrades to ``None`` fields when the backend withholds HLO text."""
+    out = {"collective_count": None, "comm_bytes": None}
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        return out
+    if not isinstance(text, str) or not text:
+        return out
+    count = 0
+    total = 0
+    for match in _HLO_COLLECTIVE_RE.finditer(text):
+        count += 1
+        for dtype, dims in _HLO_SHAPE_TOKEN_RE.findall(match.group("shape")):
+            size = 1
+            for dim in dims.split(","):
+                if dim:
+                    size *= int(dim)
+            total += size * _HLO_DTYPE_BYTES.get(dtype, 0)
+    out["collective_count"] = count
+    out["comm_bytes"] = total
     return out
 
 
@@ -335,6 +394,9 @@ class ProgramLedger:
         entry.temp_bytes = mem["temp_bytes"]
         entry.generated_code_bytes = mem["generated_code_bytes"]
         entry.hbm_peak_bytes = mem["hbm_peak_bytes"]
+        comm = analyze_comm(compiled)
+        entry.collective_count = comm["collective_count"]
+        entry.comm_bytes = comm["comm_bytes"]
         with self._lock:
             self._entries[(entry.name, entry.signature)] = entry
         if self.emit_events:

@@ -386,6 +386,12 @@ class TrainTelemetry:
                 entry = self.ledger.train_entry()
                 if entry is not None and entry.hbm_peak_bytes is not None:
                     payload["hbm_peak_bytes"] = entry.hbm_peak_bytes
+                if entry is not None and entry.comm_bytes is not None:
+                    # Collective traffic of the live train program (per
+                    # meta-iteration) — the fused-all-reduce budget as a
+                    # continuously emitted signal, not a bench-only fact.
+                    payload["comm_bytes_per_iter"] = entry.comm_bytes
+                    payload["collectives_per_iter"] = entry.collective_count
         self._observe_memory(payload, current_iter)
         if self.heartbeat_extra is not None:
             try:

@@ -95,6 +95,36 @@ state, _ = learner.run_train_iter(
 batch = (xs, xs.copy(), ys, ys.copy())
 state, _ = learner.run_train_iters(state, [batch, batch], epoch=0)
 jax.block_until_ready(state.theta)
+if {second_order}:
+    # The guarded second-order test class ALSO compiles raw GSPMD
+    # sharded-conv programs (plain jit + value_and_grad over a
+    # dp-sharded batch, and the arg-driven mp layouts) — the learner's
+    # own dp step reduces inside a shard_map-manual region since
+    # ISSUE 17 and no longer routes convs through the partitioner's
+    # convolution handler, so the probe must exercise the raw class
+    # explicitly or it would green-light tests that still abort.
+    import jax.numpy as jnp
+    from howtotrainyourmamlpytorch_tpu.parallel.sharding import (
+        batch_sharding_spec,
+    )
+
+    def raw_meta_loss(outer, bn, sharded_batch, imp):
+        loss, _ = learner._meta_loss(
+            outer, bn, sharded_batch, imp, 2, True, None, True
+        )
+        return loss
+
+    prepared = learner._prepare_batch(batch)
+    sharded = tuple(
+        jax.device_put(jnp.asarray(p), batch_sharding_spec(mesh))
+        for p in prepared
+    )
+    outer = dict(theta=state.theta, lslr=state.lslr)
+    imp = jnp.asarray(learner._train_importance(100))
+    loss, _ = jax.jit(jax.value_and_grad(raw_meta_loss))(
+        outer, state.bn_state, sharded, imp
+    )
+    jax.block_until_ready(loss)
 print("SPMD_PROBE_OK")
 """
 

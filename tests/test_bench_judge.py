@@ -212,6 +212,36 @@ def test_stale_key_detection(tmp_path):
     assert "surprise_key" in result["stale"]["ungated_keys"]
 
 
+def test_program_registry_names_parses_jax_free():
+    """The judge AST-parses ``PROGRAM_REGISTRY_NAMES`` from
+    models/common.py without importing it (no jax in this tool) — the
+    program-derived gates' declaration surface, sibling of
+    ``bench_emitted_keys``."""
+    names = bench_judge.program_registry_names()
+    assert isinstance(names, tuple)
+    assert "maml/train_multi" in names
+    assert "maml/train_step" in names
+    assert all(isinstance(n, str) for n in names)
+
+
+def test_program_sourced_gate_stale_only_when_registry_drops_it(tmp_path):
+    """A gate with source ``programs:<name>`` is judged against the live
+    program registry table: a ghost program name is a stale gate even
+    when the KEY is still bench-emitted; a registered name is not."""
+    gates = _gates({
+        "comm_bytes_per_iter": {
+            "gate": None, "source": "programs:maml/train_multi",
+        },
+        "mfu_pct": {"gate": None, "source": "programs:ghost/name"},
+    })
+    runs = bench_judge.load_trajectory(_write_trajectory(tmp_path, [
+        {"comm_bytes_per_iter": 1428, "mfu_pct": 3.8},
+    ]))
+    result = bench_judge.judge(gates, runs)
+    assert "mfu_pct" in result["stale"]["stale_gates"]
+    assert "comm_bytes_per_iter" not in result["stale"]["stale_gates"]
+
+
 def test_raw_emission_payloads_load_too(tmp_path):
     """A trajectory of raw one-line bench.py payloads (no driver wrapper)
     judges identically — the judge must accept what the tool prints."""

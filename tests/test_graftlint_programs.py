@@ -1,0 +1,471 @@
+"""graftlint v3: the IR-level program contract analyzer (ISSUE 17).
+
+Covers the program registry pin (the jax-free ``PROGRAM_REGISTRY_NAMES``
+literal vs the built registry), the tree-wide ``--programs`` CLI gate
+(every registered program clean at HEAD, the maml train forms within the
+declared collective budget), seeded positive AND negative cases for each
+of the five program rules — including THE acceptance regression:
+re-introducing per-leaf psums turns ``collective-budget`` red while the
+fused flat-bucket form passes — the scan-body-once × dispatch-multiplier
+accounting pin, and GitHub-annotation formatting for every new rule.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models.common import (
+    PROGRAM_REGISTRY_NAMES,
+    ProgramSpec,
+    registered_programs,
+)
+from tools.graftlint.programs import (
+    PROGRAM_RULES,
+    CollectiveBudgetRule,
+    DonationViolationRule,
+    DtypeLeakRule,
+    HostCallbackInStepRule,
+    SpecCoverageRule,
+    analyze_program,
+    lint_programs,
+    render_program_table,
+    walk_jaxpr,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULE_BY_ID = {rule.id: rule for rule in PROGRAM_RULES}
+
+
+def _violations(rule, analysis):
+    return list(rule.check_program(analysis))
+
+
+# ---------------------------------------------------------------------------
+# Registry pin + HEAD-clean gates
+# ---------------------------------------------------------------------------
+
+
+def test_registry_matches_declared_name_table():
+    """The jax-free AST-parsed literal and the built registry agree
+    exactly (this process has 8 devices, so every mesh variant builds) —
+    the same both-directions contract EMITTED_KEYS carries for bench."""
+    built = [spec.name for spec in registered_programs()]
+    assert sorted(built) == sorted(PROGRAM_REGISTRY_NAMES)
+    assert len(built) == len(set(built))
+
+
+def test_lint_programs_clean_at_head():
+    """THE tentpole acceptance: every registered program passes every
+    program rule at HEAD — in particular every maml train form sits
+    within the declared collective budget."""
+    assert [v.format_text() for v in lint_programs()] == []
+
+
+def test_maml_train_forms_within_budget_at_head():
+    from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+
+    budget = MAMLFewShotLearner.collective_budget
+    assert budget <= 4
+    train_forms = [
+        spec for spec in registered_programs()
+        if spec.name.startswith("maml/train")
+    ]
+    assert train_forms, "registry lost the maml train programs"
+    for spec in train_forms:
+        analysis = analyze_program(spec)
+        assert analysis.error is None, (spec.name, analysis.error)
+        assert analysis.collective_count <= budget, (
+            spec.name, analysis.collective_count
+        )
+
+
+def test_programs_cli_gate_tree_wide():
+    """The CI surface: ``python -m tools.graftlint --programs`` exits 0
+    at HEAD and prints the program table over the full registered set."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # the CLI forces its own 8-device platform
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--programs"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"{len(PROGRAM_REGISTRY_NAMES)} program(s) clean" in proc.stderr
+    for name in PROGRAM_REGISTRY_NAMES:
+        assert name in proc.stdout
+    # The maml train-step row reads within budget ("ok", never "over").
+    assert "over budget" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# collective-budget: the fused-all-reduce regression pin
+# ---------------------------------------------------------------------------
+
+
+def _dp_maml_spec(collective_fusion, budget):
+    """A dp=2 maml train-step ProgramSpec in the requested fusion mode —
+    the seeded-violation twin of the registry's maml/train_step entry."""
+    from howtotrainyourmamlpytorch_tpu.models.common import (
+        _tiny_backbone_kwargs,
+        _tiny_episode_batch,
+    )
+    from howtotrainyourmamlpytorch_tpu.models.maml import (
+        BackboneConfig,
+        MAMLConfig,
+        MAMLFewShotLearner,
+    )
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import make_mesh
+
+    def build():
+        cfg = MAMLConfig(
+            backbone=BackboneConfig(**_tiny_backbone_kwargs()),
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+            collective_fusion=collective_fusion,
+        )
+        mesh = make_mesh(
+            jax.devices()[:2], data_parallel=2, model_parallel=1
+        )
+        learner = MAMLFewShotLearner(cfg, mesh=mesh)
+        state = learner.init_state(jax.random.PRNGKey(0))
+        batch = learner._prepare_batch(_tiny_episode_batch())
+        importance = jnp.asarray(learner._train_importance(100))
+        fn = learner._get_train_step(second_order=True, final_only=True)
+        return fn, (state, batch, importance)
+
+    return ProgramSpec(
+        name=f"seeded/train_{collective_fusion}",
+        source="howtotrainyourmamlpytorch_tpu/models/maml.py",
+        build=build,
+        collective_budget=budget,
+        donate=True,
+    )
+
+
+def test_per_leaf_psum_storm_turns_collective_budget_red():
+    """THE ISSUE 17 regression: flipping the dp step back to per-leaf
+    psums (one per grad/BN/LSLR leaf) blows the declared budget and the
+    rule names the storm; the fused flat-bucket form passes the same
+    budget with exactly its per-dtype-bucket collective count."""
+    rule = RULE_BY_ID["collective-budget"]
+    assert isinstance(rule, CollectiveBudgetRule)
+
+    storm = analyze_program(_dp_maml_spec("per_leaf", budget=4))
+    assert storm.error is None, storm.error
+    assert storm.collective_count > 4
+    found = _violations(rule, storm)
+    assert len(found) == 1
+    assert "psum" in found[0].message
+    assert "collective_budget of 4" in found[0].message
+
+    fused = analyze_program(_dp_maml_spec("bucketed", budget=4))
+    assert fused.error is None, fused.error
+    assert 1 <= fused.collective_count <= 4
+    assert _violations(rule, fused) == []
+    # The storm moves no more payload than the fused form concentrates
+    # into flat buckets — the win is op count (per-op latency), and the
+    # comm-bytes column must reflect a real payload either way.
+    assert fused.comm_bytes > 0
+
+
+def test_scan_body_collectives_count_once_times_dispatch_multiplier():
+    """The dispatch-multiplier accounting pin: a K=25 scanned multi-iter
+    step walks its scan body ONCE — the collective count is the
+    per-meta-iteration count (identical to K=1), and the declared K rides
+    the spec, exactly like the FLOPs ledger's scan-body-once rule."""
+    from howtotrainyourmamlpytorch_tpu.models.common import (
+        _tiny_backbone_kwargs,
+        _tiny_episode_batch,
+        dispatch_multiplier,
+    )
+    from howtotrainyourmamlpytorch_tpu.models.maml import (
+        BackboneConfig,
+        MAMLConfig,
+        MAMLFewShotLearner,
+    )
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import make_mesh
+
+    K = 25
+
+    def build():
+        cfg = MAMLConfig(
+            backbone=BackboneConfig(**_tiny_backbone_kwargs()),
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+        )
+        mesh = make_mesh(
+            jax.devices()[:2], data_parallel=2, model_parallel=1
+        )
+        learner = MAMLFewShotLearner(cfg, mesh=mesh)
+        state = learner.init_state(jax.random.PRNGKey(0))
+        single = learner._prepare_batch(_tiny_episode_batch())
+        stacked = tuple(
+            jnp.stack([jnp.asarray(part)] * K) for part in single
+        )
+        importance = jnp.asarray(learner._train_importance(100))
+        fn = learner._get_multi_train_step(
+            second_order=True, final_only=True
+        )
+        return fn, (state, stacked, importance)
+
+    spec = ProgramSpec(
+        name="seeded/train_multi_k25",
+        source="howtotrainyourmamlpytorch_tpu/models/maml.py",
+        build=build,
+        collective_budget=4,
+        k=K,
+    )
+    analysis = analyze_program(spec)
+    assert analysis.error is None, analysis.error
+    k1 = analyze_program(_dp_maml_spec("bucketed", budget=4))
+    assert analysis.collective_count == k1.collective_count
+    assert analysis.spec.k == K
+    # The declared K the spec carries is the same multiplier the ledger
+    # derives from the stacked batch form (models/common contract).
+    _fn, (_state, stacked, _imp) = spec.build()
+    assert dispatch_multiplier(stacked) == K
+
+
+# ---------------------------------------------------------------------------
+# dtype-leak
+# ---------------------------------------------------------------------------
+
+
+def _leak_spec(compute_dtype, cast):
+    def build():
+        def fn(x, w):
+            if cast:
+                x = x.astype(jnp.bfloat16)
+                w = w.astype(jnp.bfloat16)
+            return jnp.dot(x, w)
+
+        args = (jnp.ones((4, 4), jnp.float32), jnp.ones((4, 4), jnp.float32))
+        return fn, args
+
+    return ProgramSpec(
+        name="seeded/leak", source="seeded.py", build=build,
+        compute_dtype=compute_dtype,
+    )
+
+
+def test_dtype_leak_fires_on_f32_matmul_in_declared_bf16_program():
+    rule = RULE_BY_ID["dtype-leak"]
+    assert isinstance(rule, DtypeLeakRule)
+    leaky = analyze_program(_leak_spec("bfloat16", cast=False))
+    found = _violations(rule, leaky)
+    assert len(found) == 1
+    assert "dot_general" in found[0].message
+
+
+def test_dtype_leak_negative_cases():
+    rule = RULE_BY_ID["dtype-leak"]
+    # Properly cast bf16 compute: clean.
+    assert _violations(rule, analyze_program(_leak_spec("bfloat16", cast=True))) == []
+    # f32-declared programs never run this check (f32 matmuls are the contract).
+    assert _violations(rule, analyze_program(_leak_spec("float32", cast=False))) == []
+    # The REAL declared-bf16 train step is clean by construction: the PR 9
+    # boundary casts and the f32-master update chain carry no contractions.
+    bf16 = next(
+        spec for spec in registered_programs()
+        if spec.name == "maml/train_step_bf16"
+    )
+    assert bf16.compute_dtype == "bfloat16"
+    analysis = analyze_program(bf16)
+    assert analysis.f32_contractions == {}
+    assert _violations(rule, analysis) == []
+
+
+# ---------------------------------------------------------------------------
+# donation-violation
+# ---------------------------------------------------------------------------
+
+
+def _donation_spec(donate_argnums):
+    def build():
+        def step(state, x):
+            return {"w": state["w"] + x.sum(), "b": state["b"] * 2.0}
+
+        fn = (
+            jax.jit(step, donate_argnums=donate_argnums)
+            if donate_argnums else jax.jit(step)
+        )
+        state = {"w": jnp.ones((8,)), "b": jnp.zeros((4,))}
+        return fn, (state, jnp.ones((3,)))
+
+    return ProgramSpec(
+        name="seeded/donation", source="seeded.py", build=build, donate=True,
+    )
+
+
+def test_donation_violation_fires_when_jit_drops_donation():
+    rule = RULE_BY_ID["donation-violation"]
+    assert isinstance(rule, DonationViolationRule)
+    undonated = analyze_program(_donation_spec(donate_argnums=None))
+    found = _violations(rule, undonated)
+    assert len(found) == 1
+    assert "0 of 2 donated state leaves" in found[0].message
+
+
+def test_donation_violation_negative_on_donating_jit_and_real_steps():
+    rule = RULE_BY_ID["donation-violation"]
+    donated = analyze_program(_donation_spec(donate_argnums=(0,)))
+    assert donated.aliased_outputs >= donated.donated_leaves
+    assert _violations(rule, donated) == []
+    # Every registry program that declares donation really aliases its
+    # whole state — including the sharded mp form, whose lowering defers
+    # pairing to XLA via jax.buffer_donor markers.
+    for spec in registered_programs():
+        if not spec.donate:
+            continue
+        analysis = analyze_program(spec)
+        assert _violations(rule, analysis) == [], spec.name
+
+
+# ---------------------------------------------------------------------------
+# host-callback-in-step
+# ---------------------------------------------------------------------------
+
+
+def _callback_spec(with_callback):
+    def build():
+        def fn(x):
+            if with_callback:
+                x = jax.pure_callback(
+                    lambda v: np.asarray(v) * 2.0,
+                    jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    x,
+                )
+            return x + 1.0
+
+        return fn, (jnp.ones((4,)),)
+
+    return ProgramSpec(
+        name="seeded/callback", source="seeded.py", build=build,
+    )
+
+
+def test_host_callback_rule_fires_and_stays_silent():
+    rule = RULE_BY_ID["host-callback-in-step"]
+    assert isinstance(rule, HostCallbackInStepRule)
+    hot = analyze_program(_callback_spec(True))
+    found = _violations(rule, hot)
+    assert len(found) == 1
+    assert "pure_callback" in found[0].message
+    assert _violations(rule, analyze_program(_callback_spec(False))) == []
+    for spec in registered_programs():
+        assert _violations(rule, analyze_program(spec)) == [], spec.name
+
+
+# ---------------------------------------------------------------------------
+# spec-coverage
+# ---------------------------------------------------------------------------
+
+
+def test_spec_coverage_clean_at_head():
+    rule = RULE_BY_ID["spec-coverage"]
+    assert isinstance(rule, SpecCoverageRule)
+    assert list(rule.check_registry([])) == []
+
+
+def test_spec_coverage_flags_dead_rule(monkeypatch):
+    from howtotrainyourmamlpytorch_tpu.parallel import sharding
+
+    rule = RULE_BY_ID["spec-coverage"]
+    dead = (r"phantom_module/weight$", sharding.P("model"))
+    monkeypatch.setattr(
+        sharding, "MP_STATE_RULES",
+        (dead,) + tuple(sharding.MP_STATE_RULES),
+    )
+    found = list(rule.check_registry([]))
+    assert len(found) == 1
+    assert "phantom_module" in found[0].message
+    assert "dead rule" in found[0].message
+    assert found[0].path.endswith("parallel/sharding.py")
+
+
+def test_spec_coverage_flags_unmatched_leaf(monkeypatch):
+    from howtotrainyourmamlpytorch_tpu.parallel import sharding
+
+    rule = RULE_BY_ID["spec-coverage"]
+    # Drop the DP catch-all: every state leaf of every family goes
+    # unmatched — the shard-time ValueError as a static finding.
+    monkeypatch.setattr(
+        sharding, "DP_STATE_RULES", ((r"^never-matches$", sharding.P()),),
+    )
+    found = list(rule.check_registry([]))
+    unmatched = [v for v in found if "matches no rule" in v.message]
+    assert unmatched, [v.message for v in found]
+    assert any("DP_STATE_RULES" in v.message for v in unmatched)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface: annotations, select, table rendering
+# ---------------------------------------------------------------------------
+
+
+def test_every_program_rule_registered_with_github_annotations():
+    """Each program rule rides the shared registry (``--list-rules``,
+    README sync) and its violations carry well-formed GitHub annotations
+    — the CI surface ``--programs --format=github`` prints."""
+    from tools.graftlint import RULES
+
+    seeded = {
+        "collective-budget": analyze_program(_dp_maml_spec("per_leaf", 4)),
+        "dtype-leak": analyze_program(_leak_spec("bfloat16", cast=False)),
+        "donation-violation": analyze_program(_donation_spec(None)),
+        "host-callback-in-step": analyze_program(_callback_spec(True)),
+    }
+    for rule_id, analysis in seeded.items():
+        assert rule_id in RULES
+        found = _violations(RULE_BY_ID[rule_id], analysis)
+        assert found, rule_id
+        annotation = found[0].format_github()
+        assert annotation.startswith("::error file=")
+        assert f"title=graftlint {rule_id}" in annotation
+    assert "spec-coverage" in RULES
+    table_violation = SpecCoverageRule()._table_violation("DP_STATE_RULES", "x")
+    assert "title=graftlint spec-coverage" in table_violation.format_github()
+
+
+def test_lint_programs_select_filters_rules():
+    storm = analyze_program(_dp_maml_spec("per_leaf", budget=4))
+    hits = lint_programs({"collective-budget"}, [storm])
+    assert hits and all(v.rule == "collective-budget" for v in hits)
+    assert lint_programs({"dtype-leak"}, [storm]) == []
+
+
+def test_program_table_renders_budget_status():
+    storm = analyze_program(_dp_maml_spec("per_leaf", budget=4))
+    fused = analyze_program(_dp_maml_spec("bucketed", budget=4))
+    table = render_program_table([storm, fused])
+    assert "over budget" in table
+    assert re.search(r"seeded/train_bucketed\s+\d+\s+\d+\s+4\s+1\s+ok", table)
+
+
+# ---------------------------------------------------------------------------
+# Walker semantics
+# ---------------------------------------------------------------------------
+
+
+def test_walker_descends_scan_cond_and_pjit():
+    def fn(x):
+        def body(carry, _):
+            return jnp.sin(carry) + 1.0, None
+
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return jax.lax.cond(
+            (out > 0).all(), jnp.cos, lambda v: v * 2.0, out
+        )
+
+    closed = jax.make_jaxpr(jax.jit(fn))(jnp.ones((3,)))
+    names = []
+    walk_jaxpr(closed.jaxpr, lambda eqn: names.append(eqn.primitive.name))
+    assert names.count("sin") == 1  # scan body walked once, not x3 (length)
+    assert "cos" in names  # cond branches and pjit bodies are descended

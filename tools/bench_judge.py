@@ -181,6 +181,36 @@ def bench_emitted_keys(bench_path: str | None = None) -> tuple | None:
     return None
 
 
+def program_registry_names(common_path: str | None = None) -> tuple | None:
+    """``models/common.PROGRAM_REGISTRY_NAMES`` read by AST parse — the
+    registered-program name table, by the same jax-free mechanism as
+    ``EMITTED_KEYS``. A gate whose ``source`` is ``programs:<name>`` is
+    judged against this table: the program disappearing from the registry
+    makes the gate STALE exactly like a key dropped from bench's
+    emission. ``None`` when the module is absent or the table is not a
+    literal (the cross-check is then skipped)."""
+    path = common_path or os.path.join(
+        REPO, "howtotrainyourmamlpytorch_tpu", "models", "common.py"
+    )
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "PROGRAM_REGISTRY_NAMES"
+        ):
+            try:
+                return tuple(ast.literal_eval(node.value))
+            except ValueError:
+                return None
+    return None
+
+
 def _regressed(direction: str, value: float, prior: float,
                tolerance: float, abs_slack: float) -> bool:
     slack = max(abs(prior) * tolerance, abs_slack)
@@ -294,6 +324,17 @@ def judge(gates_doc: dict, runs: list[dict]) -> dict:
         if emitted is not None
         else []
     )
+    # Program-derived gates (source "programs:<registered name>") go
+    # stale when models/common.py no longer registers the named program —
+    # the registry table is the declaration surface, exactly as
+    # EMITTED_KEYS is for bench-emitted keys.
+    registry = program_registry_names()
+    if registry is not None:
+        stale_gates = sorted(set(stale_gates) | {
+            key for key, spec in gates.items()
+            if str(spec.get("source", "")).startswith("programs:")
+            and str(spec["source"]).split(":", 1)[1] not in registry
+        })
     known = set(gates) | ungated_ok
     emission_keys = set(latest["parsed"]) | set(emitted or ())
     ungated_keys = sorted(emission_keys - known)

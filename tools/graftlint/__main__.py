@@ -38,6 +38,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule set and exit"
     )
+    parser.add_argument(
+        "--programs",
+        action="store_true",
+        help=(
+            "trace the registered learner programs (models/common."
+            "registered_programs) and run the IR-level program rules "
+            "instead of the AST rules; prints the program table when clean"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -45,13 +54,48 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rule_id}: {RULES[rule_id].summary}")
         return 0
 
-    violations = lint_paths(args.paths)
+    selected: set[str] | None = None
     if args.select:
         selected = {r.strip() for r in args.select.split(",") if r.strip()}
         unknown = selected - set(RULES) - {"bad-suppression", "parse-error"}
         if unknown:
             print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
             return 2
+
+    if args.programs:
+        # The registry's mesh variants need multiple devices; configure
+        # the virtual-CPU platform before anything imports jax.
+        from .programs import (
+            analyze_registry,
+            ensure_cpu_devices,
+            lint_programs,
+            render_program_table,
+        )
+
+        ensure_cpu_devices(8)
+        analyses = analyze_registry()
+        program_violations = lint_programs(selected, analyses)
+        for v in program_violations:
+            print(
+                v.format_github()
+                if args.format == "github"
+                else v.format_text()
+            )
+        if program_violations:
+            print(
+                f"\ngraftlint: {len(program_violations)} program "
+                f"violation(s) across {len(analyses)} traced program(s)",
+                file=sys.stderr,
+            )
+            return 1
+        print(render_program_table(analyses))
+        print(
+            f"graftlint: {len(analyses)} program(s) clean", file=sys.stderr
+        )
+        return 0
+
+    violations = lint_paths(args.paths)
+    if selected is not None:
         violations = [v for v in violations if v.rule in selected]
 
     for v in violations:

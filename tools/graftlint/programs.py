@@ -1,0 +1,518 @@
+"""graftlint v3: IR-level program contract analysis (ISSUE 17).
+
+The AST rules see source; the costliest defects in a MAML++
+reverse-over-reverse step only exist in the *lowered program* — the
+~147 per-leaf all-reduce storm (PERF_NOTES.md "Pod-scale multi-host
+protocol"), f32 leaks inside a declared-bf16 compute region, donated
+buffers XLA silently failed to alias, host callbacks reachable from a
+hot loop. This pass traces every program the learner-side registry
+(``models/common.registered_programs``) declares — ``jax.make_jaxpr``
+plus (for donation) a cache-hit ``lower()``: zero devices touched, zero
+executions — walks the IR once, and feeds five rules:
+
+* ``collective-budget`` — explicit collectives (psum / all-gather /
+  reduce-scatter / ...) per meta-iteration vs the budget the learner
+  declares in code (``collective_budget`` class attr). Scan bodies are
+  walked ONCE, mirroring ``dispatch_multiplier``'s accounting: the walk
+  count IS the per-meta-iteration count for the K-scan form.
+* ``dtype-leak`` — a dot/conv with a float32 operand inside a
+  declared-bf16 program. The PR 9 boundary casts and the f32-master
+  update chain are allowlisted by construction: casts are not matmuls
+  and Adam contains none, so a clean bf16 program has ZERO f32
+  contractions (measured; tests/test_graftlint_programs.py pins both
+  directions).
+* ``donation-violation`` — a program whose registry entry declares
+  donation but whose lowered module aliases fewer inputs than the
+  donated argument has leaves (``tf.aliasing_output``).
+* ``host-callback-in-step`` — ``pure_callback``/``io_callback``/
+  ``debug_callback`` reachable anywhere in a registered (hot) program.
+* ``spec-coverage`` — the sharding tables' static twin: every state
+  leaf of every learner family matches a partition rule, and every rule
+  matches at least one leaf somewhere (the dead-rule class, mirroring
+  ``dead-flag``).
+
+This module must stay importable WITHOUT jax (the graftlint CLI runs as
+a subprocess many times per tier-1 session); everything that traces is
+lazy inside the analysis entry points.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Iterator
+
+from .core import Violation
+from .rules import Rule
+
+#: Explicit cross-replica collective primitives (jaxpr names). GSPMD's
+#: layout-driven implicit collectives never appear in a jaxpr — which is
+#: exactly why the fused dp step makes its reduction explicit
+#: (parallel/collectives.py): countable, budgetable, lintable.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum2", "all_gather", "all_to_all", "ppermute", "pmin",
+    "pmax", "reduce_scatter", "psum_scatter", "pgather",
+})
+
+CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+})
+
+CONTRACTION_PRIMITIVES = frozenset({
+    "dot_general", "conv_general_dilated",
+})
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One explicit collective in a program's jaxpr (body-once walk)."""
+
+    primitive: str
+    nbytes: int
+
+
+@dataclasses.dataclass
+class ProgramAnalysis:
+    """Everything the program rules read about ONE registered program."""
+
+    spec: Any  # models/common.ProgramSpec
+    collectives: list[CollectiveOp] = dataclasses.field(default_factory=list)
+    f32_contractions: dict[str, int] = dataclasses.field(default_factory=dict)
+    callbacks: dict[str, int] = dataclasses.field(default_factory=dict)
+    donated_leaves: int | None = None
+    aliased_outputs: int | None = None
+    error: str | None = None
+
+    @property
+    def collective_count(self) -> int:
+        return len(self.collectives)
+
+    @property
+    def comm_bytes(self) -> int:
+        return sum(op.nbytes for op in self.collectives)
+
+
+def walk_jaxpr(jaxpr, visit) -> None:
+    """Calls ``visit(eqn)`` for every equation reachable from ``jaxpr``,
+    descending into sub-jaxprs carried in equation params (pjit, scan,
+    cond branches, shard_map, remat, custom_vjp). Each sub-jaxpr is
+    walked once per reference — a ``lax.scan`` BODY therefore counts
+    once, the ``dispatch_multiplier`` convention every per-iteration
+    consumer shares."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for value in eqn.params.values():
+            candidates = (
+                value if isinstance(value, (tuple, list)) else (value,)
+            )
+            for cand in candidates:
+                inner = getattr(cand, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    walk_jaxpr(inner, visit)
+                elif hasattr(cand, "eqns"):
+                    walk_jaxpr(cand, visit)
+
+
+def _aval_bytes(var) -> int:
+    aval = getattr(var, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    size = 1
+    for dim in shape:
+        try:
+            size *= int(dim)
+        except TypeError:  # symbolic dim
+            return 0
+    return size * dtype.itemsize
+
+
+def analyze_program(spec) -> ProgramAnalysis:
+    """Abstractly traces one registered program and walks its IR once.
+
+    ``jax.make_jaxpr`` for the primitive-level facts; when the spec
+    declares donation, an AOT ``lower()`` (no compile, no devices) for
+    the ``tf.aliasing_output`` markers. Trace failures degrade to an
+    ``error`` the rules surface instead of crashing the lint run."""
+    import jax
+
+    analysis = ProgramAnalysis(spec=spec)
+    try:
+        fn, args = spec.build()
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:  # noqa: BLE001 — surfaced as a lint finding
+        analysis.error = f"{type(exc).__name__}: {exc}"
+        return analysis
+
+    bf16 = spec.compute_dtype == "bfloat16"
+
+    def visit(eqn):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMITIVES:
+            analysis.collectives.append(CollectiveOp(
+                primitive=name,
+                nbytes=sum(_aval_bytes(v) for v in eqn.invars),
+            ))
+        elif name in CALLBACK_PRIMITIVES:
+            analysis.callbacks[name] = analysis.callbacks.get(name, 0) + 1
+        elif bf16 and name in CONTRACTION_PRIMITIVES:
+            if any(
+                str(getattr(v.aval, "dtype", "")) == "float32"
+                for v in eqn.invars
+            ):
+                analysis.f32_contractions[name] = (
+                    analysis.f32_contractions.get(name, 0) + 1
+                )
+
+    walk_jaxpr(closed.jaxpr, visit)
+
+    if spec.donate:
+        analysis.donated_leaves = len(jax.tree.leaves(args[0]))
+        try:
+            text = fn.lower(*args).as_text()
+            # Unsharded lowerings resolve aliasing eagerly
+            # (tf.aliasing_output per donated input); sharded lowerings
+            # defer the pairing to XLA and mark donors as
+            # jax.buffer_donor. Both honor the donation contract.
+            analysis.aliased_outputs = text.count(
+                "tf.aliasing_output"
+            ) + text.count("jax.buffer_donor")
+        except Exception as exc:  # noqa: BLE001 — surfaced by the rule
+            analysis.error = f"lowering failed: {type(exc).__name__}: {exc}"
+    return analysis
+
+
+def analyze_registry() -> list[ProgramAnalysis]:
+    """Analyses for every program the learner-side registry can build in
+    this process (device-count-dependent mesh variants included)."""
+    from howtotrainyourmamlpytorch_tpu.models.common import (
+        registered_programs,
+    )
+
+    return [analyze_program(spec) for spec in registered_programs()]
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+class ProgramRule(Rule):
+    """A rule over traced programs. The AST hook is a registered no-op —
+    program rules ride ``ALL_RULES`` for ``--list-rules``/README-sync/
+    ``--select`` parity, but only fire through ``lint_programs``."""
+
+    def check(self, module, project) -> Iterator[Violation]:
+        return iter(())
+
+    def check_program(self, analysis: ProgramAnalysis) -> Iterator[Violation]:
+        return iter(())
+
+    def check_registry(
+        self, analyses: list[ProgramAnalysis]
+    ) -> Iterator[Violation]:
+        return iter(())
+
+    def _pv(self, analysis: ProgramAnalysis, message: str) -> Violation:
+        return Violation(
+            rule=self.id,
+            path=analysis.spec.source,
+            line=analysis.spec.line,
+            col=0,
+            message=f"[{analysis.spec.name}] {message}",
+        )
+
+
+class CollectiveBudgetRule(ProgramRule):
+    id = "collective-budget"
+    summary = (
+        "a program's explicit per-meta-iteration collective count (scan "
+        "bodies once x declared dispatch multiplier) exceeds the budget "
+        "the learner declares in code (collective_budget)"
+    )
+
+    def check_program(self, analysis):
+        budget = analysis.spec.collective_budget
+        count = analysis.collective_count
+        if count > budget:
+            by_prim: dict[str, int] = {}
+            for op in analysis.collectives:
+                by_prim[op.primitive] = by_prim.get(op.primitive, 0) + 1
+            detail = ", ".join(
+                f"{name} x{n}" for name, n in sorted(by_prim.items())
+            )
+            yield self._pv(
+                analysis,
+                f"{count} explicit collectives per meta-iteration "
+                f"({detail}; {analysis.comm_bytes} bytes) exceed the "
+                f"declared collective_budget of {budget} — fuse the "
+                "reduction into flat dtype buckets "
+                "(parallel/collectives.fused_psum)",
+            )
+
+
+class DtypeLeakRule(ProgramRule):
+    id = "dtype-leak"
+    summary = (
+        "a dot/conv consumes float32 operands inside a declared-bf16 "
+        "program — an f32 leak in the compute region (boundary casts and "
+        "the f32-master update chain contain no contractions and never "
+        "trip this)"
+    )
+
+    def check_program(self, analysis):
+        if analysis.spec.compute_dtype != "bfloat16":
+            return
+        if analysis.f32_contractions:
+            detail = ", ".join(
+                f"{name} x{n}"
+                for name, n in sorted(analysis.f32_contractions.items())
+            )
+            yield self._pv(
+                analysis,
+                f"float32 contractions in a declared-bf16 program "
+                f"({detail}) — an operand escaped the compute-dtype "
+                "boundary cast (models/common.cast_floats)",
+            )
+
+
+class DonationViolationRule(ProgramRule):
+    id = "donation-violation"
+    summary = (
+        "a program declared as donating its state aliases fewer inputs "
+        "than the donated argument has leaves (tf.aliasing_output in the "
+        "lowered module) — XLA dropped the in-place update"
+    )
+
+    def check_program(self, analysis):
+        if not analysis.spec.donate:
+            return
+        if analysis.error and analysis.aliased_outputs is None:
+            yield self._pv(
+                analysis,
+                f"donation unverifiable — {analysis.error}",
+            )
+            return
+        donated = analysis.donated_leaves or 0
+        aliased = analysis.aliased_outputs or 0
+        if aliased < donated:
+            yield self._pv(
+                analysis,
+                f"only {aliased} of {donated} donated state leaves are "
+                "aliased to outputs in the lowered program — the "
+                "unaliased leaves double-buffer every dispatch",
+            )
+
+
+class HostCallbackInStepRule(ProgramRule):
+    id = "host-callback-in-step"
+    summary = (
+        "a pure_callback/io_callback/debug_callback is reachable in a "
+        "registered hot program — every dispatch would sync to the host"
+    )
+
+    def check_program(self, analysis):
+        if analysis.callbacks:
+            detail = ", ".join(
+                f"{name} x{n}"
+                for name, n in sorted(analysis.callbacks.items())
+            )
+            yield self._pv(
+                analysis,
+                f"host callback reachable in a hot program ({detail}) — "
+                "hoist it out of the step or gate it behind a debug "
+                "build",
+            )
+
+
+class SpecCoverageRule(ProgramRule):
+    id = "spec-coverage"
+    summary = (
+        "the partition-rule tables and the learners' states disagree: a "
+        "state leaf no rule matches, or a rule no leaf of any learner "
+        "family matches (the dead-rule class)"
+    )
+
+    #: Source anchor for table-level findings.
+    TABLES_PATH = "howtotrainyourmamlpytorch_tpu/parallel/sharding.py"
+
+    def _table_violation(self, pattern: str, message: str) -> Violation:
+        line = 1
+        try:
+            with open(self.TABLES_PATH, encoding="utf-8") as fh:
+                for lineno, text in enumerate(fh, start=1):
+                    if pattern in text:
+                        line = lineno
+                        break
+        except OSError:
+            pass
+        return Violation(
+            rule=self.id, path=self.TABLES_PATH, line=line, col=0,
+            message=message,
+        )
+
+    def check_registry(self, analyses):
+        del analyses  # table-level, not per-program
+        import re as _re
+
+        import jax
+
+        from howtotrainyourmamlpytorch_tpu.models import (
+            MAMLFewShotLearner,
+        )
+        from howtotrainyourmamlpytorch_tpu.models.common import (
+            _tiny_backbone_kwargs,
+        )
+        from howtotrainyourmamlpytorch_tpu.models.gradient_descent import (
+            GradientDescentLearner,
+        )
+        from howtotrainyourmamlpytorch_tpu.models.maml import (
+            BackboneConfig, MAMLConfig,
+        )
+        from howtotrainyourmamlpytorch_tpu.models.matching_nets import (
+            MatchingNetsLearner,
+        )
+        from howtotrainyourmamlpytorch_tpu.parallel.sharding import (
+            DP_STATE_RULES, MP_STATE_RULES, tree_path_name,
+        )
+        from jax.tree_util import tree_flatten_with_path
+
+        def cfg(**backbone_overrides):
+            kwargs = _tiny_backbone_kwargs()
+            kwargs.update(backbone_overrides)
+            return MAMLConfig(
+                backbone=BackboneConfig(**kwargs),
+                number_of_training_steps_per_iter=2,
+                number_of_evaluation_steps_per_iter=2,
+            )
+
+        # Every learner family on the default (batch-norm) backbone, plus
+        # the layer-norm backbone variant whose norm/{weight,bias} leaves
+        # keep the MP table's layer-norm rule live.
+        families = [
+            (cls, cls.__name__, cfg())
+            for cls in (MAMLFewShotLearner, GradientDescentLearner,
+                        MatchingNetsLearner)
+        ]
+        families.append((
+            MAMLFewShotLearner,
+            "MAMLFewShotLearner[layer_norm]",
+            cfg(norm_layer="layer_norm", per_step_bn_statistics=False),
+        ))
+
+        leaf_names: list[str] = []
+        for cls, family, family_cfg in families:
+            learner = cls(family_cfg)
+            state = jax.eval_shape(
+                learner.init_state, jax.random.PRNGKey(0)
+            )
+            paths, _ = tree_flatten_with_path(state)
+            leaf_names.extend(
+                f"{family}:{tree_path_name(path)}"
+                for path, _leaf in paths
+            )
+
+        for table_name, rules in (
+            ("DP_STATE_RULES", DP_STATE_RULES),
+            ("MP_STATE_RULES", MP_STATE_RULES),
+        ):
+            used = [0] * len(rules)
+            for name in leaf_names:
+                _cls, _, path = name.partition(":")
+                for index, (pattern, _spec) in enumerate(rules):
+                    if _re.search(pattern, path) is not None:
+                        used[index] += 1
+                        break
+                else:
+                    yield self._table_violation(
+                        table_name,
+                        f"state leaf {name!r} matches no rule in "
+                        f"{table_name} — it would raise at shard time "
+                        "(replicate-by-omission is refused by design)",
+                    )
+            for index, (pattern, _spec) in enumerate(rules):
+                if used[index] == 0:
+                    yield self._table_violation(
+                        pattern,
+                        f"rule {pattern!r} in {table_name} matches no "
+                        "state leaf of any learner family (first-match-"
+                        "wins order) — a dead rule, delete it or fix "
+                        "its pattern",
+                    )
+
+
+PROGRAM_RULES: list[ProgramRule] = [
+    CollectiveBudgetRule(),
+    DtypeLeakRule(),
+    DonationViolationRule(),
+    HostCallbackInStepRule(),
+    SpecCoverageRule(),
+]
+
+
+def lint_programs(
+    select: "set[str] | None" = None,
+    analyses: "list[ProgramAnalysis] | None" = None,
+) -> list[Violation]:
+    """Traces the registered program table and runs every program rule.
+
+    The whole pass is abstract — no device computation, no XLA compile
+    (donation reads the pre-compile lowering). Trace failures surface as
+    per-program findings through the rules that need the trace."""
+    if analyses is None:
+        analyses = analyze_registry()
+    violations: list[Violation] = []
+    for rule in PROGRAM_RULES:
+        if select is not None and rule.id not in select:
+            continue
+        for analysis in analyses:
+            violations.extend(rule.check_program(analysis))
+        violations.extend(rule.check_registry(analyses))
+    return sorted(
+        violations, key=lambda v: (v.path, v.line, v.rule, v.message)
+    )
+
+
+def render_program_table(analyses: "list[ProgramAnalysis] | None" = None) -> str:
+    """The ``--programs`` run's human-readable program table (README
+    "Program lint" quickstart): one row per registered program with its
+    per-meta-iteration collective count/bytes vs budget."""
+    if analyses is None:
+        analyses = analyze_registry()
+    header = (
+        f"{'program':<24} {'collectives/iter':>16} {'comm bytes':>11} "
+        f"{'budget':>7} {'k':>3}  status"
+    )
+    rows = [header, "-" * len(header)]
+    for analysis in analyses:
+        spec = analysis.spec
+        if analysis.error and analysis.aliased_outputs is None:
+            status = f"TRACE ERROR: {analysis.error}"
+            rows.append(f"{spec.name:<24} {'-':>16} {'-':>11} "
+                        f"{spec.collective_budget:>7} {spec.k:>3}  {status}")
+            continue
+        status = (
+            "over budget"
+            if analysis.collective_count > spec.collective_budget
+            else "ok"
+        )
+        rows.append(
+            f"{spec.name:<24} {analysis.collective_count:>16} "
+            f"{analysis.comm_bytes:>11} {spec.collective_budget:>7} "
+            f"{spec.k:>3}  {status}"
+        )
+    return "\n".join(rows)
+
+
+def ensure_cpu_devices(n: int = 8) -> None:
+    """CLI bootstrap: the registry's mesh variants need multiple devices;
+    force the virtual-CPU platform BEFORE jax initializes (no-op when a
+    real multi-device backend is already configured)."""
+    if os.environ.get("JAX_PLATFORMS", "cpu") != "cpu":
+        return
+    from howtotrainyourmamlpytorch_tpu.utils.platform import (
+        force_virtual_cpu,
+    )
+
+    force_virtual_cpu(n)

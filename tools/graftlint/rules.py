@@ -808,3 +808,11 @@ ALL_RULES: list[Rule] = [
 from .concurrency import CONCURRENCY_RULES  # noqa: E402
 
 ALL_RULES.extend(CONCURRENCY_RULES)
+
+# The IR-level program contract rules (graftlint v3) trace registered
+# programs through jax.make_jaxpr instead of reading source; their AST
+# hook is a no-op so they ride --list-rules/--select/README sync, and
+# they fire through `python -m tools.graftlint --programs`.
+from .programs import PROGRAM_RULES  # noqa: E402
+
+ALL_RULES.extend(PROGRAM_RULES)

@@ -193,6 +193,7 @@ def _device_section(events: list[dict], step_samples_s: list[float]):
                     "name", "role", "k", "flops", "dispatch_flops",
                     "bytes_accessed", "arithmetic_intensity",
                     "hbm_peak_bytes", "temp_bytes", "bucket",
+                    "collective_count", "comm_bytes",
                     "device_kind",
                 )
             }
@@ -266,7 +267,8 @@ def render_text(summary: dict) -> str:
         )
         dheader = (
             f"  {'program':<22} {'role':<14} {'K':>4} {'flops/iter':>12} "
-            f"{'bytes/iter':>12} {'flops/B':>8} {'hbm peak':>12}"
+            f"{'bytes/iter':>12} {'flops/B':>8} {'hbm peak':>12} "
+            f"{'coll':>5} {'comm B/iter':>12}"
         )
         lines.append(dheader)
         lines.append("  " + "-" * (len(dheader) - 2))
@@ -280,7 +282,9 @@ def render_text(summary: dict) -> str:
                 f"{row.get('k') or 1:>4} {num(row.get('flops')):>12} "
                 f"{num(row.get('bytes_accessed')):>12} "
                 f"{num(row.get('arithmetic_intensity'), '{:.2f}'):>8} "
-                f"{num(row.get('hbm_peak_bytes')):>12}"
+                f"{num(row.get('hbm_peak_bytes')):>12} "
+                f"{num(row.get('collective_count'), '{:d}'):>5} "
+                f"{num(row.get('comm_bytes'), '{:d}'):>12}"
             )
         if device.get("mfu_pct") is not None:
             lines.append(
