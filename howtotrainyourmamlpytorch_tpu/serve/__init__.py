@@ -21,7 +21,11 @@ Layers (each its own module, composable without the HTTP frontend):
 * ``resilience`` — admission control, safe hot-swap (manifest verify +
   canary + publish), and the replica flavors the pool supervises.
 * ``pool``       — N replicas behind one front door: health-checked,
-  crash-restarted with backoff + circuit breaker, re-dispatch on death.
+  crash-restarted with backoff + circuit breaker, re-dispatch on death,
+  optional digest-affine routing over a consistent-hash ring.
+* ``tier``       — durable serving state: crash-consistent artifact
+  spill (disk tier under the LRU), integrity-fenced AOT executable
+  cache (zero-compile warm respawn), and the routing hash ring.
 * ``api``        — ``ServingAPI`` (in-process) + the stdlib HTTP frontend
   (``/v1/episode``, ``/admin/promote``, ``/healthz``, ``/metrics``),
   bindable over one engine or a whole pool.
@@ -34,7 +38,7 @@ rate + recovery time).
 
 from .api import ServingAPI, make_http_server
 from .batcher import MicroBatcher
-from .cache import AdaptedParamsCache, support_digest
+from .cache import AdaptedParamsCache, routing_digest, support_digest
 from .engine import EpisodeRequest, ServeConfig, ServingEngine
 from .errors import (
     DeadlineExceededError,
@@ -53,6 +57,7 @@ __all__ = [
     "make_http_server",
     "MicroBatcher",
     "AdaptedParamsCache",
+    "routing_digest",
     "support_digest",
     "EpisodeRequest",
     "ServeConfig",

@@ -18,11 +18,19 @@ because every new digest embeds the new version).
 Capacity is counted in EPISODES, not bytes: the artifact size per learner
 is fixed (matching nets: a few KB of embeddings; MAML: the fast-weight
 tree; GD: a full parameter tree), so the owner sizes capacity per learner.
+
+With a durable tier attached (``attach_spill``), the LRU becomes the RAM
+front of a two-level cache: ``put`` writes through to the disk spill and
+``get`` falls back to a verified disk read on a RAM miss (promoting the
+entry back into RAM). Spill I/O happens OUTSIDE the cache lock — a slow
+disk must not serialize the serving hot path — and every spill failure
+mode degrades to a plain miss, so attaching a tier can only add hits.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 from collections import OrderedDict
 from typing import Any
@@ -49,6 +57,17 @@ def support_digest(
     return h.hexdigest()
 
 
+def routing_digest(x_support: np.ndarray, y_support: np.ndarray) -> str:
+    """Version/learner-INDEPENDENT support hash, for fleet routing only.
+
+    The pool's consistent-hash ring must keep an episode pinned to the
+    same replica across state swaps (the replica's spill holds that
+    episode's history), so the routing key deliberately omits the
+    ``learner``/``state_version`` fields that ``support_digest`` embeds
+    for cache-correctness."""
+    return support_digest(x_support, y_support, learner="", state_version=0)
+
+
 class AdaptedParamsCache:
     """Thread-safe LRU over adapted-params pytrees.
 
@@ -65,16 +84,62 @@ class AdaptedParamsCache:
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, Any] = OrderedDict()
         self.evictions = 0
+        self._spill = None  # ArtifactSpill when a durable tier is attached
+        self._spill_learner: str | None = None
+        self._spill_version: int = 0
+        self.spill_hits = 0
+
+    def attach_spill(self, spill, *, learner: str, state_version: int) -> None:
+        """Attach (or re-key, after a state swap) the durable disk tier.
+
+        ``learner``/``state_version`` pin the identity spill reads verify
+        against — the owner re-attaches on every published-state bump so
+        rehydrated entries can never cross a version boundary."""
+        self._spill = spill
+        self._spill_learner = str(learner)
+        self._spill_version = int(state_version)
+
+    @property
+    def spill(self):
+        return self._spill
 
     def get(self, digest: str):
-        """The cached artifact, or None. Refreshes LRU recency on hit."""
+        """The cached artifact, or None. Refreshes LRU recency on hit.
+
+        On a RAM miss with a spill attached, probes the disk tier
+        (outside the lock) and promotes a verified hit back into RAM."""
         with self._lock:
-            if digest not in self._entries:
-                return None
-            self._entries.move_to_end(digest)
-            return self._entries[digest]
+            if digest in self._entries:
+                self._entries.move_to_end(digest)
+                return self._entries[digest]
+        if self._spill is None:
+            return None
+        artifact = self._spill.get(
+            digest,
+            learner=self._spill_learner,
+            state_version=self._spill_version,
+        )
+        if artifact is None:
+            return None
+        self.spill_hits += 1
+        self.put_ram(digest, artifact)
+        return artifact
 
     def put(self, digest: str, artifact: Any) -> None:
+        self.put_ram(digest, artifact)
+        if self._spill is not None:
+            # Write-through, outside the lock; the spill swallows I/O
+            # failures into its stats (RAM still holds the artifact).
+            self._spill.put(
+                digest,
+                artifact,
+                learner=self._spill_learner,
+                state_version=self._spill_version,
+            )
+
+    def put_ram(self, digest: str, artifact: Any) -> None:
+        """RAM-only insert (no write-through) — the rehydration entry
+        point, where the artifact just came FROM the spill."""
         if self.capacity == 0:
             return
         with self._lock:
@@ -94,4 +159,10 @@ class AdaptedParamsCache:
 
     def __contains__(self, digest: str) -> bool:
         with self._lock:
-            return digest in self._entries
+            if digest in self._entries:
+                return True
+        if self._spill is not None:
+            # Existence only (no verify): feeds the pre-dispatch
+            # cache-hit metric; the dispatch path still verifies.
+            return os.path.exists(self._spill.path_for(digest))
+        return False

@@ -33,6 +33,7 @@ timed per dispatch into the latency histograms (``serve/metrics.py``).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, NamedTuple, Sequence
@@ -48,6 +49,7 @@ from ..utils import faultinject
 from .cache import AdaptedParamsCache, support_digest
 from .errors import SwapRejectedError
 from .metrics import ServeMetrics
+from .tier import ArtifactSpill, ExecutableCache
 
 Tree = Any
 
@@ -123,6 +125,16 @@ class ServeConfig:
     max_queue_age_ms: float = 2_000.0
     #: ``Retry-After`` seconds returned with shed (503) responses.
     retry_after_s: float = 1.0
+    #: Durable serving tier root (``serve/tier/``). When set, the
+    #: adapted-params cache writes through to a crash-consistent disk
+    #: spill at ``<tier_dir>/spill`` (rehydrated at construction) and
+    #: warmup serialize/deserializes its executables at
+    #: ``<tier_dir>/exec`` — a warm respawn performs zero XLA compiles.
+    #: ``None`` disables the tier (RAM-only caches, today's behavior).
+    tier_dir: str | None = None
+    #: Disk-spill retention, in entries; oldest entries (mtime) are
+    #: pruned past this. <= 0 disables pruning.
+    spill_max_entries: int = 4096
 
     def __post_init__(self):
         if self.meta_batch_size < 1:
@@ -227,6 +239,32 @@ class ServingEngine:
         # signatures on the hot path, pinned under compile_guard), and
         # exported on /metrics next to the compile table.
         self.ledger = ProgramLedger()
+        # Durable tier (serve/tier/): crash-consistent artifact spill +
+        # integrity-fenced AOT executable cache. The spill is attached as
+        # the RAM LRU's disk tier and this replica's surviving hot set is
+        # rehydrated at construction; ``_aot`` maps runtime signatures to
+        # deserialized executables, which dispatch/warmup/canary prefer
+        # over the jit wrappers (zero compiles on a warm respawn).
+        self._spill: ArtifactSpill | None = None
+        self._exec_cache: ExecutableCache | None = None
+        self._aot: dict[str, Any] = {}
+        if self.config.tier_dir:
+            self._spill = ArtifactSpill(
+                os.path.join(self.config.tier_dir, "spill"),
+                max_entries=self.config.spill_max_entries,
+            )
+            self.cache.attach_spill(
+                self._spill, learner=self.family, state_version=0
+            )
+            self._exec_cache = ExecutableCache(
+                os.path.join(self.config.tier_dir, "exec")
+            )
+            self._spill.rehydrate_into(
+                self.cache,
+                learner=self.family,
+                state_version=0,
+                limit=self.config.cache_capacity,
+            )
         self._adapt, self._classify = self._build_programs()
 
     # ------------------------------------------------------------------
@@ -276,6 +314,87 @@ class ServingEngine:
             return dict(self._compiles)
 
     # ------------------------------------------------------------------
+    # Durable AOT executables (serve/tier/execcache.py)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _signature(kind: str, *parts) -> str:
+        """Stable runtime signature of one program invocation: kind plus
+        the dtype:shape of every leaf across all arguments (istate
+        included — the executable is specialized to its avals). Attribute
+        reads only: no host transfers, no device syncs."""
+        leaves = jax.tree_util.tree_leaves(parts)
+        return kind + ";" + ";".join(
+            f"{getattr(leaf, 'dtype', type(leaf).__name__)}:"
+            f"{getattr(leaf, 'shape', ())}"
+            for leaf in leaves
+        )
+
+    def _run_adapt(self, istate, xs, ys):
+        if self._aot:
+            loaded = self._aot.get(self._signature("adapt", istate, xs, ys))
+            if loaded is not None:
+                return loaded(istate, xs, ys)
+        return self._adapt(istate, xs, ys)
+
+    def _run_classify(self, istate, stacked, xq):
+        if self._aot:
+            loaded = self._aot.get(
+                self._signature("classify", istate, stacked, xq)
+            )
+            if loaded is not None:
+                return loaded(istate, stacked, xq)
+        return self._classify(istate, stacked, xq)
+
+    def _persist_exec(self, kind: str, sig: str, args, lowered=None) -> None:
+        """Serialize this signature's executable into the durable exec
+        cache (best-effort). Only called for signatures NOT served from
+        the AOT cache, where ``lower().compile()`` is an in-process jit
+        cache hit — the program was compiled by this very dispatch."""
+        if self._exec_cache is None:
+            return
+        program = f"serve_{kind}_{self.family}"
+        if self._exec_cache.has(program, sig):
+            return
+        fn = self._adapt if kind == "adapt" else self._classify
+        compiled = (
+            lowered if lowered is not None else fn.lower(*args)
+        ).compile()
+        self._exec_cache.put(program, sig, compiled)
+
+    def tier_stats(self) -> dict | None:
+        """Durable-tier observability snapshot, or None when disabled."""
+        if self._spill is None and self._exec_cache is None:
+            return None
+        out: dict[str, Any] = {}
+        if self._spill is not None:
+            out["spill"] = dict(self._spill.stats)
+            out["spill_promotions"] = self.cache.spill_hits
+        if self._exec_cache is not None:
+            out["exec"] = dict(self._exec_cache.stats)
+            out["aot_programs"] = len(self._aot)
+        return out
+
+    def rehydrate_spill(self, tier_dir: str) -> int:
+        """Adopt verified artifacts from ANOTHER tier directory into this
+        replica's RAM cache — the ring-rebalance path: on a peer's
+        retirement the pool calls this on the successor with the dead
+        replica's tier dir, so the inherited arc's hot set is served from
+        cache, not re-adapted. Entries for other ``(learner,
+        state_version)`` identities are skipped by the spill's verify
+        contract; failures degrade to a smaller adoption count."""
+        spill = ArtifactSpill(
+            os.path.join(str(tier_dir), "spill"),
+            max_entries=self.config.spill_max_entries,
+        )
+        return spill.rehydrate_into(
+            self.cache,
+            learner=self.family,
+            state_version=self.state_version,
+            limit=self.config.cache_capacity,
+        )
+
+    # ------------------------------------------------------------------
     # State management
     # ------------------------------------------------------------------
 
@@ -298,6 +417,16 @@ class ServingEngine:
             old.version + 1, self.learner.inference_state(state)
         )
         self.cache.clear()
+        if self._spill is not None:
+            # Re-key the disk tier to the new publish epoch: rehydration
+            # and spill reads now verify against the bumped version, so
+            # pre-swap entries are unreachable (and age out via the
+            # spill's retention pruning), exactly like the RAM LRU.
+            self.cache.attach_spill(
+                self._spill,
+                learner=self.family,
+                state_version=self._published.version,
+            )
         return self._published.version
 
     def warmed_buckets(self) -> list[tuple[int, int, int]]:
@@ -323,18 +452,40 @@ class ServingEngine:
         bucket_label = "x".join(str(d) for d in bucket)
         try:
             if xs is not None:
-                label = "adapt:" + "x".join(str(d) for d in xs.shape[:2])
-                if not self.ledger.has_entry(label):
-                    self.ledger.record_lowered(
-                        label, self._adapt.lower(istate, xs, ys),
-                        k=1, role="serve_adapt", bucket=bucket_label,
+                sig = self._signature("adapt", istate, xs, ys)
+                # Signatures served from the durable AOT cache skip BOTH
+                # paths below: in a fresh process ``lower().compile()``
+                # would be a REAL backend compile (the in-process jit
+                # cache is empty), breaking the warm respawn's
+                # zero-compile contract — and the executable is already
+                # persisted by whichever process compiled it.
+                if sig not in self._aot:
+                    label = "adapt:" + "x".join(str(d) for d in xs.shape[:2])
+                    lowered = None
+                    if not self.ledger.has_entry(label):
+                        lowered = self._adapt.lower(istate, xs, ys)
+                        self.ledger.record_lowered(
+                            label, lowered,
+                            k=1, role="serve_adapt", bucket=bucket_label,
+                        )
+                    self._persist_exec(
+                        "adapt", sig, (istate, xs, ys), lowered
                     )
             if xq is not None and stacked is not None:
-                label = "classify:" + "x".join(str(d) for d in xq.shape[:2])
-                if not self.ledger.has_entry(label):
-                    self.ledger.record_lowered(
-                        label, self._classify.lower(istate, stacked, xq),
-                        k=1, role="serve_classify", bucket=bucket_label,
+                sig = self._signature("classify", istate, stacked, xq)
+                if sig not in self._aot:
+                    label = (
+                        "classify:" + "x".join(str(d) for d in xq.shape[:2])
+                    )
+                    lowered = None
+                    if not self.ledger.has_entry(label):
+                        lowered = self._classify.lower(istate, stacked, xq)
+                        self.ledger.record_lowered(
+                            label, lowered,
+                            k=1, role="serve_classify", bucket=bucket_label,
+                        )
+                    self._persist_exec(
+                        "classify", sig, (istate, stacked, xq), lowered
                     )
         except Exception:  # noqa: BLE001 — observability must not fail a dispatch
             pass
@@ -474,7 +625,7 @@ class ServingEngine:
             xs = self._pad_rows([eps[i].x_support for i in miss])
             ys = self._pad_rows([eps[i].y_support for i in miss])
             t0 = time.perf_counter()
-            adapted = self._adapt(istate, xs, ys)
+            adapted = self._run_adapt(istate, xs, ys)
             adapted = jax.block_until_ready(adapted)
             adapt_ms = (time.perf_counter() - t0) * 1e3
             self.metrics.adapt_latency.observe(adapt_ms)
@@ -491,7 +642,7 @@ class ServingEngine:
         )
         xq = self._pad_rows([ep.x_query for ep in eps])
         t0 = time.perf_counter()
-        logits = self._classify(istate, stacked, xq)
+        logits = self._run_classify(istate, stacked, xq)
         logits = jax.block_until_ready(logits)
         classify_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.classify_latency.observe(classify_ms)
@@ -563,21 +714,44 @@ class ServingEngine:
         query)`` bucket so first-request latency is a dispatch, not an XLA
         compile, and marks the engine ready. Bypasses the cache (synthetic
         warmup episodes must not occupy capacity or answer a real
-        request)."""
+        request).
+
+        With a durable tier configured, each bucket probes the AOT
+        executable cache first: a verified hit deserializes the warmed
+        executable (zero XLA compiles — the warm-respawn contract pinned
+        in ``tests/test_serve_tier.py``); a miss compiles via the jit
+        wrapper and persists the executable for the next respawn (in
+        ``_ledger_record``'s AOT ingest, an in-process cache hit)."""
         istate = self._published.istate
         for way, shot, query in buckets:
             ep = self._synthetic_episode(way, shot, query)
             xs_b = self._pad_rows([ep.x_support])
             ys_b = self._pad_rows([ep.y_support])
-            adapted = self._adapt(istate, xs_b, ys_b)
+            adapted = self._warm_one("adapt", istate, xs_b, ys_b)
             xq_b = self._pad_rows([ep.x_query])
-            self._classify(istate, adapted, xq_b)
+            self._warm_one("classify", istate, adapted, xq_b)
             self._note_bucket(ep.bucket)
             self._ledger_record(
                 ep.bucket, istate, xs=xs_b, ys=ys_b,
                 stacked=adapted, xq=xq_b,
             )
         self.ready = True
+
+    def _warm_one(self, kind: str, istate, *rest):
+        """Warm one program signature, preferring the durable AOT cache."""
+        fn = self._adapt if kind == "adapt" else self._classify
+        args = (istate,) + rest
+        if self._exec_cache is None:
+            return fn(*args)
+        sig = self._signature(kind, *args)
+        if sig not in self._aot:
+            loaded = self._exec_cache.get(f"serve_{kind}_{self.family}", sig)
+            if loaded is not None:
+                self._aot[sig] = loaded
+        loaded = self._aot.get(sig)
+        if loaded is not None:
+            return jax.block_until_ready(loaded(*args))
+        return fn(*args)
 
     # ------------------------------------------------------------------
     # Hot-swap canary
@@ -601,8 +775,12 @@ class ServingEngine:
             ep = self._synthetic_episode(way, shot, query)
             xs_b = self._pad_rows([ep.x_support])
             ys_b = self._pad_rows([ep.y_support])
-            adapted = self._adapt(istate, xs_b, ys_b)
-            logits = self._classify(istate, adapted, self._pad_rows([ep.x_query]))
+            # The _run_* helpers keep canaries compile-free on a warm
+            # respawn too (candidate istate shares the published avals).
+            adapted = self._run_adapt(istate, xs_b, ys_b)
+            logits = self._run_classify(
+                istate, adapted, self._pad_rows([ep.x_query])
+            )
             host = faultinject.poison_logits(
                 np.asarray(jax.block_until_ready(logits))
             )

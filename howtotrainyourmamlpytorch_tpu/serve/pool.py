@@ -35,6 +35,7 @@ swap.py``), and only on acceptance does it roll to the rest.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 
@@ -52,8 +53,10 @@ from .errors import (
     ReplicaDeadError,
     SwapRejectedError,
 )
+from .cache import routing_digest
 from .metrics import Counter, LatencyStat
 from .resilience.replica import Replica
+from .tier import HashRing
 
 #: Slot lifecycle: STARTING -(ready healthz)-> HEALTHY -(strikes/death)->
 #: RETIRED -(backoff)-> STARTING ... -(crash loop)-> CIRCUIT_OPEN.
@@ -86,6 +89,21 @@ class PoolConfig:
     #: Per-attempt replica call budget (bounds how long a silently-wedged
     #: replica can hold a caller before the retry fires).
     dispatch_timeout_s: float = 30.0
+    #: Route episodes to replicas by consistent hash of the support-set
+    #: routing digest (serve/tier/ring.py) instead of round-robin, so
+    #: each replica's hot set (RAM LRU + disk spill) is DISJOINT and the
+    #: fleet's aggregate cache capacity scales with replica count. Ring
+    #: membership follows health: a retired replica's arc moves to its
+    #: successor; re-dispatch after a mid-request death re-routes there.
+    route_by_digest: bool = False
+    #: Fleet durable-tier root: replica ``i``'s tier lives at
+    #: ``<tier_root>/replica-<i>`` (the factory wires each replica's
+    #: ``ServeConfig.tier_dir`` to match). When set, a retirement also
+    #: asks the ring successor to rehydrate the dead replica's spill
+    #: directory — the inherited arc arrives with its history.
+    tier_root: str | None = None
+    #: Virtual nodes per replica on the routing ring.
+    ring_vnodes: int = 64
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -101,7 +119,8 @@ class _Slot:
 
     __slots__ = (
         "index", "replica", "state", "strikes", "consecutive_failures",
-        "restarts", "next_restart_at", "healthy_since",
+        "restarts", "next_restart_at", "healthy_since", "start_began",
+        "last_ready_s",
     )
 
     def __init__(self, index: int):
@@ -113,6 +132,12 @@ class _Slot:
         self.restarts = 0
         self.next_restart_at = 0.0
         self.healthy_since: float | None = None
+        #: When the current start attempt began (monotonic) — the birth
+        #: timestamp the ready-time measurement is taken against.
+        self.start_began: float | None = None
+        #: Last observed start→healthy latency (the ``serve_replica_ready_s``
+        #: bench key: warm durable tier makes this collapse).
+        self.last_ready_s: float | None = None
 
     def describe(self) -> dict:
         return {
@@ -145,6 +170,8 @@ class PoolMetrics:
         # promotion daemon's post-publish SLO watch sees live numeric
         # regressions on ONE /metrics surface.
         self.nonfinite_logits_total = Counter("nonfinite_logits_total")
+        # Dead-replica spill directories adopted by a ring successor.
+        self.rehydrations_total = Counter("rehydrations_total")
         self.request_latency = LatencyStat("request")
 
 
@@ -173,6 +200,14 @@ class ReplicaPool:
         #: daemon can resume idempotently (was my in-flight candidate
         #: already published?).
         self._last_promoted: dict | None = None
+        # Digest-affine routing (serve/tier/ring.py): membership follows
+        # health, mutated and consulted only under the pool lock. The
+        # rehydration queue carries (dead_index, successor_index) pairs
+        # out of _retire_locked; the supervisor drains it OUTSIDE the
+        # lock — a disk-bound rehydrate must not park the dispatchers.
+        self._ring = HashRing(self.config.ring_vnodes)
+        self._rehydrate_q: list[tuple[int, int]] = []
+        self._last_ready_s: float | None = None
         for slot in self._slots:
             self._try_start(slot)
         self._supervisor = threading.Thread(
@@ -184,11 +219,24 @@ class ReplicaPool:
     # Dispatch (front door)
     # ------------------------------------------------------------------
 
-    def _pick(self) -> tuple[_Slot, Replica] | None:
-        """Next healthy (slot, replica) pair, round-robin; ``None`` when
-        the fleet is out. The replica is captured under the lock so a
-        concurrent retirement can never hand the caller a ``None``."""
+    def _pick(
+        self, routing_key: str | None = None
+    ) -> tuple[_Slot, Replica] | None:
+        """Healthy (slot, replica) pair for a request; ``None`` when the
+        fleet is out. With a routing key and a populated ring, the owner
+        of the key's arc is chosen (digest-affine: the same support set
+        always lands on the replica holding its cached artifact);
+        otherwise round-robin. The replica is captured under the lock so
+        a concurrent retirement can never hand the caller a ``None``."""
         with self._lock:
+            if routing_key is not None and len(self._ring):
+                owner = self._ring.route(routing_key)
+                if owner is not None:
+                    slot = self._slots[owner]
+                    if slot.state == HEALTHY and slot.replica is not None:
+                        return slot, slot.replica
+                    # Health flipped between ring update and here — fall
+                    # through to round-robin over whoever is left.
             healthy = [
                 s for s in self._slots
                 if s.state == HEALTHY and s.replica is not None
@@ -216,9 +264,22 @@ class ReplicaPool:
         )
         attempts = self.config.max_dispatch_retries + 1
         last_death: ReplicaDeadError | None = None
+        routing_key = None
+        if self.config.route_by_digest:
+            # Version/learner-independent support hash, computed ONCE at
+            # the front door. Re-dispatch after a death re-routes with
+            # the same key — the ring has already moved the arc to the
+            # successor, which (tier_root set) rehydrates the dead
+            # replica's spill.
+            try:
+                routing_key = routing_digest(
+                    np.asarray(x_support), np.asarray(y_support)
+                )
+            except Exception:
+                routing_key = None  # malformed input fails in prepare, not here
         try:
             for attempt in range(attempts):
-                picked = self._pick()
+                picked = self._pick(routing_key)
                 if picked is None:
                     raise NoHealthyReplicaError(
                         "no healthy replica available "
@@ -295,6 +356,15 @@ class ReplicaPool:
         replica = slot.replica
         if replica is not None:
             self._graveyard.append(replica)
+        # Ring rebalance: the dead replica's arc moves to its successor,
+        # and (durable tier configured) the successor is queued to adopt
+        # the dead spill directory — drained by the supervisor outside
+        # this lock, because rehydration is real disk + verify work.
+        if slot.index in self._ring:
+            self._ring.remove(slot.index)
+            successor = self._ring.successor(slot.index)
+            if successor is not None and self.config.tier_root:
+                self._rehydrate_q.append((slot.index, int(successor)))
         # Young death (never healthy, or healthy for less than min_uptime)
         # extends the crash streak; a replica that proved itself by serving
         # a while resets it. One that NEVER became healthy (factory failure,
@@ -333,6 +403,7 @@ class ReplicaPool:
     def _try_start(self, slot: _Slot) -> None:
         """Builds a replica for ``slot`` (factory may block; called at
         construction and from the supervisor thread)."""
+        slot.start_began = time.monotonic()
         try:
             replica = self.factory(slot.index)
         except Exception as exc:
@@ -384,9 +455,16 @@ class ReplicaPool:
                 if slot.state != HEALTHY:
                     slot.state = HEALTHY
                     slot.healthy_since = time.monotonic()
+                    if slot.start_began is not None:
+                        slot.last_ready_s = (
+                            slot.healthy_since - slot.start_began
+                        )
+                        self._last_ready_s = slot.last_ready_s
+                    self._ring.add(slot.index)
                     telemetry_events.emit(
                         "replica_healthy", slot=slot.index,
                         restarts=slot.restarts,
+                        ready_s=slot.last_ready_s,
                     )
             else:
                 slot.state = STARTING  # alive, still warming
@@ -397,6 +475,7 @@ class ReplicaPool:
                 if self._closed:
                     return
                 graveyard, self._graveyard = self._graveyard, []
+                rehydrations, self._rehydrate_q = self._rehydrate_q, []
                 due = [
                     s for s in self._slots
                     if s.state == RETIRED
@@ -411,6 +490,8 @@ class ReplicaPool:
                     replica.terminate()
                 except Exception:
                     pass  # already gone — termination is best-effort
+            for dead_index, succ_index in rehydrations:
+                self._rehydrate_one(dead_index, succ_index)
             for slot in due:
                 self._try_start(slot)
             for slot in probes:
@@ -419,6 +500,36 @@ class ReplicaPool:
                 if self._closed:
                     return
                 self._lock.wait(self.config.health_interval_s)
+
+    def _rehydrate_one(self, dead_index: int, succ_index: int) -> None:
+        """Ask the ring successor to adopt a dead replica's spill dir.
+
+        Best-effort by contract: the successor may itself have died, the
+        replica flavor may not support rehydration (HTTP replicas), or
+        the spill may verify down to nothing — every failure mode leaves
+        the successor serving correctly, just colder."""
+        assert self.config.tier_root is not None
+        with self._lock:
+            slot = self._slots[succ_index]
+            replica = (
+                slot.replica if slot.state == HEALTHY else None
+            )
+        if replica is None:
+            return
+        spill_dir = os.path.join(
+            self.config.tier_root, f"replica-{dead_index}"
+        )
+        try:
+            adopted = replica.rehydrate_spill(spill_dir)
+        except Exception:
+            return
+        self.metrics.rehydrations_total.inc()
+        telemetry_events.emit(
+            "spill_rehydrated",
+            dead_slot=dead_index,
+            successor=succ_index,
+            entries=adopted,
+        )
 
     # ------------------------------------------------------------------
     # Operational surface (ServingAPI-shaped)
@@ -541,6 +652,9 @@ class ReplicaPool:
             "replica_restarts_total": m.replica_restarts_total.value,
             "circuit_open_total": m.circuit_open_total.value,
             "nonfinite_logits_total": m.nonfinite_logits_total.value,
+            "rehydrations_total": m.rehydrations_total.value,
+            "replica_ready_s": self._last_ready_s,
+            "ring_nodes": len(self._ring),
             "latency_ms": {"request": m.request_latency.snapshot()},
             "replicas": self.healthz()["replicas"],
         }
@@ -566,6 +680,10 @@ class ReplicaPool:
             f"{p}_circuit_open_total {m.circuit_open_total.value}",
             f"# TYPE {p}_nonfinite_logits_total counter",
             f"{p}_nonfinite_logits_total {m.nonfinite_logits_total.value}",
+            f"# TYPE {p}_rehydrations_total counter",
+            f"{p}_rehydrations_total {m.rehydrations_total.value}",
+            f"# TYPE {p}_replica_ready_s gauge",
+            f"{p}_replica_ready_s {self._last_ready_s or 0.0:.6f}",
             f"# TYPE {p}_healthy_replicas gauge",
             f"{p}_healthy_replicas {health['healthy_replicas']}",
             f"# TYPE {p}_degraded gauge",

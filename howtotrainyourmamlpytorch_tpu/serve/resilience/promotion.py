@@ -78,6 +78,11 @@ PHASE_ROLLBACK_START = "rollback_start"
 PHASE_ROLLED_BACK = "rolled_back"
 PHASE_DEDUPED = "deduped"
 PHASE_RESUMED = "resumed"
+#: Audit row for staging-dir GC: the named staged copy was (about to be)
+#: removed under the retention policy. Journal-then-act, and replay
+#: treats it as audit-only — retirement prunes bytes, it never changes a
+#: candidate's lifecycle verdict.
+PHASE_RETIRED = "retired"
 
 TERMINAL_PHASES = (PHASE_REJECTED, PHASE_SLO_OK, PHASE_ROLLED_BACK)
 
@@ -87,6 +92,7 @@ KILL_PRE_VERIFY = 1  # ``start`` journaled, candidate not yet verified
 KILL_PRE_PUBLISH = 2  # ``verified`` journaled, fleet not yet touched
 KILL_POST_PUBLISH = 3  # fleet promoted, ``promoted`` row not yet written
 KILL_PRE_RESOLVE = 4  # ``promoted`` journaled, SLO watch unresolved
+KILL_MID_GC = 5  # ``retired`` row journaled, staged copy not yet removed
 
 
 class PromotionTransportError(Exception):
@@ -131,6 +137,13 @@ class PromotionConfig:
     #: Minimum answered requests in the window before error-rate/p99
     #: verdicts apply (a 1-request window must not decide a rollback).
     min_requests: int = 1
+    #: Staging-dir retention beyond the always-kept last-known-good and
+    #: in-flight copies: the N newest (mtime) other staged candidates
+    #: survive each GC pass, the rest are removed with journaled
+    #: ``retired`` rows. Candidates land roughly once per epoch, so the
+    #: staging dir is bounded at ~(2 + N) checkpoint copies instead of
+    #: growing with training length (disk-fill is a slow-motion outage).
+    retain_staged: int = 2
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +207,13 @@ def replay_journal(rows: list[dict]) -> dict:
     for row in rows:
         digest = row.get("digest")
         if not digest:
+            continue
+        if row["phase"] == PHASE_RETIRED:
+            # Staging-GC audit row: the candidate keeps whatever terminal
+            # verdict it already journaled (folding it into last_phase
+            # would resurrect a resolved digest as "in-flight" on
+            # resume), and its ``staged`` field is a basename — folding
+            # THAT into info would corrupt the entry's full staged path.
             continue
         entry = info.setdefault(digest, {"digest": digest})
         for key in ("path", "staged", "epoch", "val_stat"):
@@ -790,9 +810,15 @@ class PromotionDaemon:
         self._gc_staging()
 
     def _gc_staging(self) -> None:
-        """Drops staged copies whose lifecycle resolved and which are not
-        the retained last-known-good — retention is exactly what rollback
-        needs, nothing more."""
+        """Bounded staging retention: the last-known-good and any
+        in-flight copy are always kept (they are the rollback targets),
+        plus the ``retain_staged`` newest other copies; everything older
+        is removed, each removal journaled as a ``retired`` row FIRST.
+        Journal-then-act makes the prune idempotent across a SIGKILL
+        (``KILL_MID_GC``): a kill between row and unlink leaves a
+        retired-but-present copy that the next pass simply re-retires,
+        and replay treats ``retired`` as audit-only, so resume state
+        never changes."""
         keep = set()
         if self._lkg:
             keep.add(os.path.basename(str(self._lkg.get("staged"))))
@@ -802,9 +828,31 @@ class PromotionDaemon:
             names = os.listdir(self.config.staging_dir)
         except OSError:
             return
+        # Audit linkage for the journal: staged basename -> digest.
+        staged_digest = {
+            os.path.basename(str(entry.get("staged"))): digest
+            for digest, entry in self._info.items()
+            if entry.get("staged")
+        }
+        aged: list[tuple[float, str]] = []
         for name in names:
             if name in keep:
                 continue
+            try:
+                mtime = os.path.getmtime(
+                    os.path.join(self.config.staging_dir, name)
+                )
+            except OSError:
+                continue  # raced another remover — already gone
+            aged.append((mtime, name))
+        aged.sort(reverse=True)  # newest first; retain the head
+        for _mtime, name in aged[max(0, self.config.retain_staged):]:
+            self.journal.append(
+                PHASE_RETIRED,
+                digest=staged_digest.get(name),
+                staged=name,
+            )
+            faultinject.daemon_phase(KILL_MID_GC)
             try:
                 os.remove(os.path.join(self.config.staging_dir, name))
             except OSError:

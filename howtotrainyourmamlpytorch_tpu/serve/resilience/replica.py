@@ -61,6 +61,13 @@ class Replica:
     def promote(self, checkpoint_path: str) -> dict:
         raise NotImplementedError
 
+    def rehydrate_spill(self, tier_dir: str) -> int:
+        """Adopt a dead peer's durable-tier spill directory (consistent-
+        hash ring rebalance, ``serve/pool.py``). Returns the number of
+        artifacts adopted; the default flavor supports no durable tier
+        and adopts nothing."""
+        return 0
+
     def terminate(self) -> None:
         raise NotImplementedError
 
@@ -129,6 +136,13 @@ class LocalReplica(Replica):
             "state_version": result.version,
             "buckets_canaried": len(result.buckets_canaried),
         }
+
+    def rehydrate_spill(self, tier_dir: str) -> int:
+        if self._dead or self._wedged:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} cannot rehydrate"
+            )
+        return self.api.engine.rehydrate_spill(tier_dir)
 
     def terminate(self) -> None:
         self._dead = True

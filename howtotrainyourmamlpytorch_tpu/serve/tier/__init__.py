@@ -1,0 +1,29 @@
+"""Durable serving state tier: crash-consistent artifact spill,
+integrity-fenced AOT executable cache, and the consistent-hash ring the
+fleet routes by. See each module's docstring for its contract; the README
+"Durable serving tier" section documents the on-disk key layout, fence
+fields, and the fault → detection → recovery matrix."""
+
+from .atomic import (
+    ExecCacheStaleError,
+    TierCorruptError,
+    TierError,
+    atomic_write_bytes,
+    quarantine,
+)
+from .execcache import ExecutableCache, build_fence, serialization_available
+from .ring import HashRing
+from .spill import ArtifactSpill
+
+__all__ = [
+    "ArtifactSpill",
+    "ExecCacheStaleError",
+    "ExecutableCache",
+    "HashRing",
+    "TierCorruptError",
+    "TierError",
+    "atomic_write_bytes",
+    "build_fence",
+    "quarantine",
+    "serialization_available",
+]

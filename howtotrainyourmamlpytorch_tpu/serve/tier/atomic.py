@@ -1,0 +1,114 @@
+"""Crash-consistent publish primitives for the durable serving tier.
+
+Every byte the tier persists goes through :func:`atomic_write_bytes`:
+write to a same-directory temp file, flush, ``fsync`` the file, then
+``os.replace`` onto the final name and ``fsync`` the parent directory.
+A reader can therefore only ever observe (a) no file, (b) the previous
+complete file, or (c) the new complete file — never a torn prefix.
+The ``durable-write`` graftlint rule pins the tier (and journal/spill
+call sites elsewhere) to this helper; a bare ``open(path, "w")`` in
+this package is a lint error by construction.
+
+The typed error ladder mirrors the PR 3 checkpoint contract:
+
+* :class:`TierError` — transient I/O (disk full, permission); the tier
+  degrades to its cold path and the caller retries nothing.
+* :class:`TierCorruptError` — integrity failure (CRC/parse/truncation);
+  the entry is *quarantined* (renamed ``*.corrupt``) so it is consulted
+  exactly once and preserved for forensics.
+* :class:`ExecCacheStaleError` — a structurally intact executable built
+  under a different version fence (jaxlib/backend/config drift); not
+  corruption, but unusable: the caller recompiles and overwrites.
+
+Requested-vs-stored identity mismatches (wrong learner family or
+``state_version`` for a digest) raise plain :class:`ValueError`, same
+as the checkpoint loader's structural mismatches.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import zlib
+
+from ...telemetry import events as telemetry_events
+from ...utils import faultinject
+
+
+class TierError(Exception):
+    """Transient durable-tier I/O failure (degrade to cold path)."""
+
+
+class TierCorruptError(TierError):
+    """Integrity-check failure: quarantine the entry, serve cold."""
+
+
+class ExecCacheStaleError(TierError):
+    """Executable was built under a different version fence."""
+
+
+def crc32_bytes(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Publish ``data`` at ``path`` with tmp+fsync+rename semantics.
+
+    Consults the one-shot ``torn_spill_write`` fault hook: when armed,
+    the *published* file is truncated mid-payload — simulating a torn
+    write that survived a crash because the rename happened but the
+    payload fsync was forged. Readers must detect this via CRC, which
+    is exactly what the chaos tests pin.
+    """
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    payload = faultinject.torn_spill_write(data)
+    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+    try:
+        try:
+            os.write(fd, payload)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(parent)
+
+
+def _fsync_dir(parent: str) -> None:
+    # Directory fsync is best-effort: not all filesystems/platforms
+    # support opening a directory for fsync, and losing it only widens
+    # the crash window to "entry absent", which readers treat as a miss.
+    try:
+        dfd = os.open(parent, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+def quarantine(path: str, *, reason: str, kind: str) -> str:
+    """Rename a failed entry to ``*.corrupt`` and emit telemetry.
+
+    Idempotent and best-effort: a second reader racing the rename sees
+    a plain miss. Returns the quarantine path (whether or not the
+    rename succeeded) so callers can log it.
+    """
+    dest = path + ".corrupt"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        pass
+    telemetry_events.emit(
+        "tier_quarantined", path=path, reason=reason, kind=kind
+    )
+    return dest

@@ -1,0 +1,228 @@
+"""Integrity-fenced AOT executable cache — durable warmup.
+
+``serve/engine.warmup()`` compiles one adapt + one classify program per
+shape bucket; on a fleet respawn that compilation dominates
+time-to-ready. This cache serializes the warmed executables
+(``jax.experimental.serialize_executable``) so a respawned replica
+deserializes instead of recompiling — the acceptance bar is ZERO XLA
+compiles on a warm respawn, pinned under ``compile_guard``.
+
+Key vs fence — two layers on purpose:
+
+* the **key** (filename) hashes the *lookup identity*: program name,
+  argument shape/dtype signature, backend, and device kind. Same
+  program + shapes on the same accelerator → same file.
+* the **fence** (stored inside the envelope, re-verified on every load)
+  carries the full build provenance: jax + jaxlib versions, backend,
+  device kind, program, signature, and the donation/sharding config the
+  programs are built with. Drift the key cannot see — a jaxlib upgrade,
+  a donation-policy change — is caught here and rejected as *stale*
+  (typed, telemetered), then overwritten by a fresh compile.
+
+An executable cache can therefore only ever make cold-start faster,
+never wronger: corrupt envelope → quarantine + compile; stale fence →
+telemetry + compile; deserialization failure → quarantine + compile.
+
+Serialization availability is probed once and degraded gracefully — on
+a jax build without ``serialize_executable`` the cache is inert (every
+``get`` misses, every ``put`` is a no-op) rather than an import error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+
+import jax
+
+from ...telemetry import events as telemetry_events
+from ...utils import faultinject
+from .atomic import (
+    ExecCacheStaleError,
+    TierCorruptError,
+    TierError,
+    atomic_write_bytes,
+    crc32_bytes,
+    quarantine,
+)
+
+SCHEMA = 1
+_SUFFIX = ".exec.bin"
+
+try:  # guarded: not a pip dependency decision, just API surface drift
+    from jax.experimental.serialize_executable import (
+        deserialize_and_load as _deserialize_and_load,
+        serialize as _serialize,
+    )
+except Exception:  # pragma: no cover - exercised on older jax builds
+    _serialize = None
+    _deserialize_and_load = None
+
+
+def _jaxlib_version() -> str:
+    try:
+        import jaxlib
+
+        return str(jaxlib.__version__)
+    except Exception:  # pragma: no cover
+        return "unknown"
+
+
+def serialization_available() -> bool:
+    return _serialize is not None and _deserialize_and_load is not None
+
+
+def build_fence(program: str, signature: str) -> dict:
+    """Full build-provenance fence for one executable."""
+    devices = jax.devices()
+    return {
+        "schema": SCHEMA,
+        "jax": str(jax.__version__),
+        "jaxlib": _jaxlib_version(),
+        "backend": str(jax.default_backend()),
+        "device_kind": str(devices[0].device_kind) if devices else "none",
+        "program": str(program),
+        "signature": str(signature),
+        # Serve programs are built with no donated buffers on a
+        # single-device (replicated-state) layout; a future donation or
+        # sharding change to engine._build_programs must bump these so
+        # pre-change executables fence out instead of loading.
+        "donation": "none",
+        "sharding": "single-device",
+    }
+
+
+class ExecutableCache:
+    """Durable store of serialized warmed serve executables."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.stats = {
+            "hits": 0,
+            "misses": 0,
+            "stale": 0,
+            "corrupt_quarantined": 0,
+            "io_errors": 0,
+            "writes": 0,
+        }
+
+    def path_for(self, program: str, signature: str) -> str:
+        fence = build_fence(program, signature)
+        key = hashlib.sha256(
+            "|".join(
+                (fence["program"], fence["signature"], fence["backend"],
+                 fence["device_kind"])
+            ).encode()
+        ).hexdigest()
+        return os.path.join(self.root, key + _SUFFIX)
+
+    def has(self, program: str, signature: str) -> bool:
+        return os.path.exists(self.path_for(program, signature))
+
+    # -- write path ------------------------------------------------------
+
+    def put(self, program: str, signature: str, compiled) -> bool:
+        """Serialize + publish one compiled executable (best-effort)."""
+        if not serialization_available():
+            return False
+        path = self.path_for(program, signature)
+        try:
+            payload_bytes, in_tree, out_tree = _serialize(compiled)
+            payload = pickle.dumps((payload_bytes, in_tree, out_tree))
+        except Exception as exc:
+            telemetry_events.emit(
+                "tier_exec_put_failed", program=program, error=str(exc)
+            )
+            return False
+        header = json.dumps(
+            {
+                "schema": SCHEMA,
+                "fence": build_fence(program, signature),
+                "payload_crc32": crc32_bytes(payload),
+            }
+        ).encode()
+        try:
+            atomic_write_bytes(path, header + b"\n" + payload)
+        except (OSError, TierError):
+            with self._lock:
+                self.stats["io_errors"] += 1
+            return False
+        with self._lock:
+            self.stats["writes"] += 1
+        return True
+
+    # -- read path -------------------------------------------------------
+
+    def get(self, program: str, signature: str):
+        """Load + fence-verify + deserialize; None on any failure.
+
+        The degradation ladder is typed and telemetered: corrupt →
+        quarantine, stale fence → reject (file kept for forensics until
+        the fresh compile overwrites it), deserialize failure →
+        quarantine. The caller compiles plainly on None.
+        """
+        if not serialization_available():
+            return None
+        path = self.path_for(program, signature)
+        if not os.path.exists(path):
+            with self._lock:
+                self.stats["misses"] += 1
+            return None
+        try:
+            loaded = self._load_verified(path, program, signature)
+        except ExecCacheStaleError as exc:
+            with self._lock:
+                self.stats["stale"] += 1
+            telemetry_events.emit(
+                "tier_exec_stale", program=program, reason=str(exc)
+            )
+            return None
+        except TierCorruptError as exc:
+            quarantine(path, reason=str(exc), kind="executable")
+            with self._lock:
+                self.stats["corrupt_quarantined"] += 1
+            return None
+        except OSError:
+            with self._lock:
+                self.stats["io_errors"] += 1
+            return None
+        with self._lock:
+            self.stats["hits"] += 1
+        telemetry_events.emit("tier_exec_cache_hit", program=program)
+        return loaded
+
+    def _load_verified(self, path: str, program: str, signature: str):
+        with open(path, "rb") as f:
+            raw = f.read()
+        header_line, sep, payload = raw.partition(b"\n")
+        if not sep:
+            raise TierCorruptError("executable envelope has no header")
+        try:
+            header = json.loads(header_line.decode())
+        except Exception as exc:
+            raise TierCorruptError(f"undecodable header: {exc}") from exc
+        if int(header.get("schema", -1)) != SCHEMA:
+            raise TierCorruptError(f"schema {header.get('schema')!r}")
+        if crc32_bytes(payload) != int(header.get("payload_crc32", -1)):
+            raise TierCorruptError("payload CRC mismatch")
+        stored = faultinject.stale_exec_cache(dict(header.get("fence", {})))
+        expected = build_fence(program, signature)
+        drift = {
+            k: (stored.get(k), v)
+            for k, v in expected.items()
+            if stored.get(k) != v
+        }
+        if drift:
+            raise ExecCacheStaleError(f"fence drift: {drift}")
+        try:
+            payload_bytes, in_tree, out_tree = pickle.loads(payload)
+            return _deserialize_and_load(payload_bytes, in_tree, out_tree)
+        except Exception as exc:
+            raise TierCorruptError(
+                f"executable deserialization failed: {exc}"
+            ) from exc

@@ -1,0 +1,89 @@
+"""Consistent-hash ring for digest-affine fleet routing.
+
+The pool routes each episode to the replica owning its routing digest on
+the ring, so every replica's hot set (RAM LRU + disk spill) is disjoint
+and the fleet's aggregate cache capacity scales with replica count
+instead of replicating one hot set N times. Virtual nodes (default 64
+per replica) keep ownership shares within a few percent of uniform;
+sha256 keeps placement stable across processes and platforms (no reliance
+on Python's randomized ``hash``).
+
+Membership mutations are O(vnodes·log n) and rare (replica health
+transitions); routing is a single ``bisect``. Thread safety is the
+caller's job — ``serve/pool.py`` mutates and routes under its pool lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+
+def _point(token: str) -> int:
+    return int(hashlib.sha256(token.encode()).hexdigest()[:16], 16)
+
+
+class HashRing:
+    """Minimal consistent-hash ring over hashable node ids."""
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._points: list[int] = []  # sorted vnode positions
+        self._owner: dict[int, object] = {}  # position -> node id
+        self._nodes: set = set()
+
+    def __contains__(self, node) -> bool:
+        return node in self._nodes
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def nodes(self) -> set:
+        return set(self._nodes)
+
+    def _vnode_points(self, node) -> list[int]:
+        return [_point(f"{node}#{i}") for i in range(self.vnodes)]
+
+    def add(self, node) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for pos in self._vnode_points(node):
+            # sha256 collisions across distinct tokens are not a real
+            # case; last-add-wins keeps the structure consistent anyway.
+            if pos not in self._owner:
+                bisect.insort(self._points, pos)
+            self._owner[pos] = node
+
+    def remove(self, node) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        for pos in self._vnode_points(node):
+            if self._owner.get(pos) is node or self._owner.get(pos) == node:
+                del self._owner[pos]
+                idx = bisect.bisect_left(self._points, pos)
+                if idx < len(self._points) and self._points[idx] == pos:
+                    del self._points[idx]
+
+    def route(self, key: str):
+        """Owner of ``key``: first vnode clockwise of its hash point."""
+        return self._route_point(_point(str(key)))
+
+    def _route_point(self, pos: int):
+        if not self._points:
+            return None
+        idx = bisect.bisect_right(self._points, pos)
+        if idx == len(self._points):
+            idx = 0  # wrap
+        return self._owner[self._points[idx]]
+
+    def successor(self, node):
+        """The member that inherits ``node``'s primary arc once ``node``
+        has left the ring — i.e. the owner, post-removal, of the keys
+        that hashed just after ``node``'s first vnode. Used by the pool
+        to pick which survivor rehydrates a dead replica's spill."""
+        return self._route_point(_point(f"{node}#0"))

@@ -1,0 +1,333 @@
+"""Disk spill for adapted-episode artifacts — the durable half of the
+serving LRU.
+
+Each entry is ONE ``.npz`` file published atomically (see ``atomic.py``),
+so crash atomicity is a single rename — there is no torn two-file pair to
+reason about. The file carries its own integrity contract, mirroring the
+PR 3 checkpoint manifest:
+
+* ``manifest`` — JSON: schema version, the sha256 episode digest the
+  entry is keyed by, learner family, ``state_version``, leaf count,
+  per-leaf CRC32s, and the tree-structure fingerprint (CRC32 of the
+  canonical key-path encoding, same contract as ``utils/checkpoint.py``);
+* ``treedef`` — the pickled treedef (uint8), so rehydration rebuilds the
+  exact artifact pytree;
+* ``leaf_00000 …`` — the artifact leaves as numpy arrays.
+
+Reads verify every CRC and the fingerprint before a byte reaches the
+serving path. A failed verify quarantines the entry (``*.corrupt``) and
+returns a miss — the caller re-adapts cold. A structurally intact entry
+whose stored ``(learner, state_version)`` disagrees with the requested
+identity is a *mismatch*, not corruption: it is skipped (counted), never
+quarantined, because it is a valid entry for some other publish epoch.
+
+Keys embed ``(learner, state_version)`` via ``serve/cache.support_digest``,
+so a state swap makes every stale entry unreachable by construction; the
+identity check here is defense in depth against digest collisions across
+formula changes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import threading
+import zlib
+
+import jax
+import numpy as np
+
+from ...telemetry import events as telemetry_events
+from ...utils import faultinject
+from .atomic import TierCorruptError, TierError, atomic_write_bytes, quarantine
+
+SCHEMA = 1
+_SUFFIX = ".artifact.npz"
+
+
+def _tree_fingerprint(tree) -> int:
+    """CRC32 of the canonical key-path encoding (checkpoint contract)."""
+    from jax.tree_util import (
+        DictKey,
+        FlattenedIndexKey,
+        GetAttrKey,
+        SequenceKey,
+        tree_flatten_with_path,
+    )
+
+    paths_and_leaves, _ = tree_flatten_with_path(tree)
+    parts = []
+    for path, _leaf in paths_and_leaves:
+        for entry in path:
+            if isinstance(entry, DictKey):
+                parts.append(f"d:{entry.key}")
+            elif isinstance(entry, SequenceKey):
+                parts.append(f"s:{entry.idx}")
+            elif isinstance(entry, GetAttrKey):
+                parts.append(f"a:{entry.name}")
+            elif isinstance(entry, FlattenedIndexKey):
+                parts.append(f"i:{entry.key}")
+            else:
+                parts.append(f"?:{entry!r}")
+        parts.append("|")
+    return zlib.crc32(";".join(parts).encode())
+
+
+def _leaf_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+class ArtifactSpill:
+    """Content-addressed durable store of adapted-params artifacts.
+
+    All methods are thread-safe for the serving pattern (concurrent
+    ``get``s, write-through ``put``s): puts are atomic renames of
+    content-addressed files (a racing double-put publishes identical
+    bytes), and the stats dict is guarded by a small lock.
+    """
+
+    def __init__(self, root: str, *, max_entries: int = 4096):
+        self.root = str(root)
+        self.max_entries = int(max_entries)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.stats = {
+            "writes": 0,
+            "hits": 0,
+            "misses": 0,
+            "corrupt_quarantined": 0,
+            "mismatch_skipped": 0,
+            "io_errors": 0,
+            "pruned": 0,
+        }
+
+    # -- key layout ------------------------------------------------------
+
+    def path_for(self, digest: str) -> str:
+        # Two-hex-char shard dirs keep directory fan-out bounded at fleet
+        # cache sizes (the digest is uniformly distributed sha256).
+        return os.path.join(self.root, digest[:2], digest + _SUFFIX)
+
+    # -- write path ------------------------------------------------------
+
+    def put(self, digest: str, artifact, *, learner: str, state_version: int) -> bool:
+        """Write-through publish; returns True when a new entry landed.
+
+        Never raises into the serving path: transient I/O failures are
+        counted and swallowed (the RAM tier still holds the artifact).
+        """
+        path = self.path_for(digest)
+        if os.path.exists(path):
+            return False  # content-addressed: same digest == same bytes
+        try:
+            payload = self._encode(
+                digest, artifact, learner=learner, state_version=state_version
+            )
+            atomic_write_bytes(path, payload)
+        except (OSError, TierError):
+            with self._lock:
+                self.stats["io_errors"] += 1
+            return False
+        with self._lock:
+            self.stats["writes"] += 1
+        self._maybe_prune()
+        return True
+
+    def _encode(
+        self, digest: str, artifact, *, learner: str, state_version: int
+    ) -> bytes:
+        leaves, treedef = jax.tree_util.tree_flatten(artifact)
+        np_leaves = [np.asarray(leaf) for leaf in leaves]
+        manifest = {
+            "schema": SCHEMA,
+            "digest": digest,
+            "learner": str(learner),
+            "state_version": int(state_version),
+            "leaf_count": len(np_leaves),
+            "leaf_crc32": [_leaf_crc(a) for a in np_leaves],
+            "tree_crc32": _tree_fingerprint(artifact),
+        }
+        arrays = {
+            "manifest": np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8
+            ),
+            "treedef": np.frombuffer(pickle.dumps(treedef), dtype=np.uint8),
+        }
+        for i, arr in enumerate(np_leaves):
+            arrays[f"leaf_{i:05d}"] = arr
+        bio = io.BytesIO()
+        np.savez(bio, **arrays)
+        return bio.getvalue()
+
+    # -- read path -------------------------------------------------------
+
+    def get(self, digest: str, *, learner: str, state_version: int):
+        """Verified read; returns the artifact pytree or None (miss).
+
+        Every failure mode degrades to a miss — corrupt entries are
+        quarantined with a telemetry event, identity mismatches are
+        skipped, transient I/O is counted. The serving path above treats
+        None as "adapt cold"; this method can therefore never make an
+        answer wrong, only slower.
+        """
+        path = self.path_for(digest)
+        if not os.path.exists(path):
+            with self._lock:
+                self.stats["misses"] += 1
+            return None
+        faultinject.corrupt_cache_entry(path)
+        try:
+            artifact = self._read_verified(
+                path, digest, learner=learner, state_version=state_version
+            )
+        except TierCorruptError as exc:
+            quarantine(path, reason=str(exc), kind="artifact")
+            with self._lock:
+                self.stats["corrupt_quarantined"] += 1
+            return None
+        except ValueError:
+            with self._lock:
+                self.stats["mismatch_skipped"] += 1
+            return None
+        except OSError:
+            with self._lock:
+                self.stats["io_errors"] += 1
+            return None
+        with self._lock:
+            self.stats["hits"] += 1
+        telemetry_events.emit(
+            "tier_spill_hit", digest=digest[:16], learner=learner
+        )
+        return artifact
+
+    def _read_verified(
+        self, path: str, digest: str, *, learner: str, state_version: int
+    ):
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            with np.load(io.BytesIO(raw)) as npz:
+                arrays = {name: npz[name] for name in npz.files}
+        except Exception as exc:  # torn zip, bad magic, truncated member
+            raise TierCorruptError(f"unreadable spill entry: {exc}") from exc
+        for key in ("manifest", "treedef"):
+            if key not in arrays:
+                raise TierCorruptError(f"spill entry missing {key!r}")
+        try:
+            manifest = json.loads(bytes(arrays["manifest"].tobytes()).decode())
+        except Exception as exc:
+            raise TierCorruptError(f"undecodable manifest: {exc}") from exc
+        if int(manifest.get("schema", -1)) != SCHEMA:
+            raise TierCorruptError(
+                f"schema {manifest.get('schema')!r} != {SCHEMA}"
+            )
+        if manifest.get("digest") != digest:
+            raise TierCorruptError("entry digest disagrees with filename")
+        # Identity mismatch: a VALID entry for another epoch — not rot.
+        if (
+            manifest.get("learner") != str(learner)
+            or int(manifest.get("state_version", -1)) != int(state_version)
+        ):
+            raise ValueError(
+                f"spill entry is {manifest.get('learner')}/v"
+                f"{manifest.get('state_version')}, wanted "
+                f"{learner}/v{state_version}"
+            )
+        leaf_count = int(manifest["leaf_count"])
+        crcs = manifest["leaf_crc32"]
+        if len(crcs) != leaf_count:
+            raise TierCorruptError("manifest leaf_crc32 length mismatch")
+        leaves = []
+        for i in range(leaf_count):
+            name = f"leaf_{i:05d}"
+            if name not in arrays:
+                raise TierCorruptError(f"spill entry missing {name}")
+            arr = arrays[name]
+            if _leaf_crc(arr) != int(crcs[i]):
+                raise TierCorruptError(f"leaf {i} CRC mismatch")
+            leaves.append(arr)
+        try:
+            treedef = pickle.loads(bytes(arrays["treedef"].tobytes()))
+            artifact = jax.tree_util.tree_unflatten(treedef, leaves)
+        except TierCorruptError:
+            raise
+        except Exception as exc:
+            raise TierCorruptError(f"treedef unpickle failed: {exc}") from exc
+        if _tree_fingerprint(artifact) != int(manifest["tree_crc32"]):
+            raise TierCorruptError("tree fingerprint mismatch")
+        return artifact
+
+    # -- enumeration / rehydration --------------------------------------
+
+    def entries(self) -> list[str]:
+        """Digests currently on disk (quarantined/tmp files excluded)."""
+        out = []
+        try:
+            shards = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for shard in shards:
+            shard_dir = os.path.join(self.root, shard)
+            if not os.path.isdir(shard_dir):
+                continue
+            try:
+                names = sorted(os.listdir(shard_dir))
+            except OSError:
+                continue
+            for name in names:
+                if name.endswith(_SUFFIX):
+                    out.append(name[: -len(_SUFFIX)])
+        return out
+
+    def rehydrate_into(
+        self, cache, *, learner: str, state_version: int, limit: int
+    ) -> int:
+        """Load up to ``limit`` verified entries into an in-RAM cache
+        (``AdaptedParamsCache``-shaped: ``put_ram(digest, artifact)``).
+        Returns the number of artifacts adopted. Entries for other
+        identities, and anything that fails verification, are skipped by
+        ``get``'s degradation contract."""
+        adopted = 0
+        for digest in self.entries():
+            if adopted >= max(0, int(limit)):
+                break
+            artifact = self.get(
+                digest, learner=learner, state_version=state_version
+            )
+            if artifact is None:
+                continue
+            cache.put_ram(digest, artifact)
+            adopted += 1
+        if adopted:
+            telemetry_events.emit(
+                "tier_rehydrated",
+                entries=adopted,
+                learner=learner,
+                state_version=int(state_version),
+            )
+        return adopted
+
+    # -- retention -------------------------------------------------------
+
+    def _maybe_prune(self) -> None:
+        """Drop oldest entries past ``max_entries`` (mtime order)."""
+        if self.max_entries <= 0:
+            return
+        digests = self.entries()
+        excess = len(digests) - self.max_entries
+        if excess <= 0:
+            return
+        paths = [self.path_for(d) for d in digests]
+        try:
+            paths.sort(key=lambda p: os.path.getmtime(p))
+        except OSError:
+            return
+        for path in paths[:excess]:
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            with self._lock:
+                self.stats["pruned"] += 1

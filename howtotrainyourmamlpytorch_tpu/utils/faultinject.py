@@ -84,6 +84,21 @@ Control-plane faults (the continuous train→serve loop,
   non-finite responses, the live-regression class that only a
   POST-publish SLO watch can catch — the canary ran clean.
 
+Durable-tier faults (the crash-consistent serving state tier,
+``serve/tier/`` — spill + AOT executable cache):
+
+* ``torn_spill_write_at`` — the Kth durable-tier publish (counted from
+  plan activation, 1-based) lands TORN: the atomic helper renames a
+  truncated payload into place, simulating a crash where the rename
+  survived but the data fsync was forged by the drive — the reader's
+  per-leaf CRC/manifest verify must quarantine it and serve cold;
+* ``corrupt_cache_entry_at`` — flip bytes in the middle of the on-disk
+  entry consulted by the Kth spill read (post-publish bit-rot), proving
+  the CRC-verify → quarantine-as-``*.corrupt`` → cold-adapt path;
+* ``stale_exec_cache_at`` — the Kth AOT-executable-cache load sees its
+  stored version fence mutated (a jaxlib/backend drift the key did not
+  capture), proving the typed stale rejection + plain-compile fallback.
+
 Activation is programmatic (``activate(FaultPlan(...))`` from tests) or via
 the environment: ``MAML_FAULTS="nan_at_iter=40,sigterm_at_iter=120"``
 (comma/semicolon-separated ``key=int`` pairs), read once on first use so a
@@ -131,11 +146,17 @@ class FaultPlan:
     kill_trainer_mid_publish: int = 0
     daemon_kill_at_phase: int | None = None
     regress_after_promote: int = 0
+    torn_spill_write_at: int | None = None
+    corrupt_cache_entry_at: int | None = None
+    stale_exec_cache_at: int | None = None
 
 
 _UNSET = object()  # env not yet consulted
 _plan: FaultPlan | None | object = _UNSET
 _serve_requests = 0  # process-global classify-request count (serve faults)
+_tier_writes = 0  # process-global durable-tier publish count
+_tier_reads = 0  # process-global spill-entry read count
+_exec_loads = 0  # process-global AOT-executable-cache load count
 
 
 def _plan_from_env() -> FaultPlan | None:
@@ -178,6 +199,7 @@ def activate(plan: FaultPlan) -> FaultPlan:
     global _plan, _serve_requests
     _plan = plan
     _serve_requests = 0
+    _reset_tier_counters()
     events.clear()
     return plan
 
@@ -187,6 +209,7 @@ def deactivate() -> None:
     global _plan, _serve_requests
     _plan = None
     _serve_requests = 0
+    _reset_tier_counters()
     events.clear()
 
 
@@ -195,7 +218,15 @@ def reset() -> None:
     global _plan, _serve_requests
     _plan = _UNSET
     _serve_requests = 0
+    _reset_tier_counters()
     events.clear()
+
+
+def _reset_tier_counters() -> None:
+    global _tier_writes, _tier_reads, _exec_loads
+    _tier_writes = 0
+    _tier_reads = 0
+    _exec_loads = 0
 
 
 # ---------------------------------------------------------------------------
@@ -487,3 +518,60 @@ def poison_logits(logits: np.ndarray) -> np.ndarray:
     plan.nan_next_logits -= 1
     events.append(f"nan-logits:{plan.nan_next_logits}")
     return np.full_like(np.asarray(logits, dtype=np.float32), np.nan)
+
+def torn_spill_write(data: bytes) -> bytes:
+    """Consulted by ``serve/tier/atomic.atomic_write_bytes`` on every
+    durable publish; the ``torn_spill_write_at``-th publish (1-based,
+    counted from activation) returns a truncated payload so the rename
+    lands a torn file — the reader-side CRC verify must catch it."""
+    plan = _active()
+    if plan is None or plan.torn_spill_write_at is None:
+        return data
+    global _tier_writes
+    _tier_writes += 1
+    if plan.torn_spill_write_at != _tier_writes:
+        return data
+    plan.torn_spill_write_at = None
+    cut = max(1, len(data) // 2)
+    events.append(f"torn-spill:{cut}")
+    return data[:cut]
+
+
+def corrupt_cache_entry(path: str) -> None:
+    """Consulted by the spill reader before each entry read; the
+    ``corrupt_cache_entry_at``-th read (1-based) first flips bytes in the
+    middle of the on-disk entry (post-publish bit-rot), so the CRC verify
+    quarantines it and the caller degrades to a cold adapt."""
+    plan = _active()
+    if plan is None or plan.corrupt_cache_entry_at is None:
+        return
+    global _tier_reads
+    _tier_reads += 1
+    if plan.corrupt_cache_entry_at != _tier_reads:
+        return
+    plan.corrupt_cache_entry_at = None
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(max(0, size // 2))
+            f.write(b"\xde\xad\xbe\xef")
+    except OSError:
+        pass
+    events.append(f"corrupt-entry:{os.path.basename(path)}")
+
+
+def stale_exec_cache(fence: dict) -> dict:
+    """Consulted by the AOT executable cache on each load with the STORED
+    fence; the ``stale_exec_cache_at``-th load (1-based) sees the fence
+    mutated — a version drift the key failed to capture — so the loader's
+    fence re-verify must reject it as stale and recompile."""
+    plan = _active()
+    if plan is None or plan.stale_exec_cache_at is None:
+        return fence
+    global _exec_loads
+    _exec_loads += 1
+    if plan.stale_exec_cache_at != _exec_loads:
+        return fence
+    plan.stale_exec_cache_at = None
+    events.append("stale-exec-fence")
+    return {**fence, "jaxlib": "0.0.0-faulted"}

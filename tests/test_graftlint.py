@@ -708,3 +708,61 @@ def test_device_probe_negative_module_without_bringup_import():
     devices = jax.devices()
     """
     assert "device-probe-before-distributed-init" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# durable-write
+# ---------------------------------------------------------------------------
+
+
+def test_durable_write_positive_truncating_open_in_tier_module():
+    # Inside serve/tier/ every truncating open is a violation — only the
+    # atomic helper itself may touch the bytes directly.
+    src = """
+    def save(path, data):
+        with open(path, "wb") as f:
+            f.write(data)
+    """
+    path = "howtotrainyourmamlpytorch_tpu/serve/tier/spill.py"
+    assert "durable-write" in rules_of(src, path)
+
+
+def test_durable_write_positive_journal_path_anywhere():
+    src = """
+    def rewrite(journal_path, rows):
+        with open(journal_path, "w") as f:
+            f.write(rows)
+    """
+    assert "durable-write" in rules_of(src)
+
+
+def test_durable_write_negative_append_read_and_atomic_helper():
+    # Journal appends, reads, and the sanctioned atomic writer all pass;
+    # so does a write-mode open on a path with no durable marker.
+    src = """
+    def append(journal_path, row):
+        with open(journal_path, "a") as f:
+            f.write(row)
+
+    def load(spill_path):
+        with open(spill_path, "rb") as f:
+            return f.read()
+
+    def dump_log(log_path, text):
+        with open(log_path, "w") as f:
+            f.write(text)
+    """
+    assert "durable-write" not in rules_of(src)
+    atomic = """
+    import os
+    import tempfile
+
+    def atomic_write_bytes(path, data):
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        os.write(fd, data)
+        os.fsync(fd)
+        os.close(fd)
+        os.replace(tmp, path)
+    """
+    path = "howtotrainyourmamlpytorch_tpu/serve/tier/atomic.py"
+    assert "durable-write" not in rules_of(atomic, path)

@@ -158,6 +158,15 @@ PLANES = {
                    "tracing.py", "locksan.py"},
         "zero_suppressions": True,
     },
+    "serve-tier": {
+        # ISSUE 18: the durable serving tier (atomic writer, artifact
+        # spill, AOT executable cache, routing ring) lints clean with
+        # zero suppressions — including its own durable-write rule.
+        "targets": [f"{PKG}/serve/tier"],
+        "expect": {"__init__.py", "atomic.py", "spill.py", "execcache.py",
+                   "ring.py"},
+        "zero_suppressions": True,
+    },
     "program-plane": {
         # ISSUE 17: the IR-level program analyzer and the fused-collective
         # machinery its budget rule enforces lint clean under the full
@@ -340,6 +349,11 @@ _SEEDED_CLI_CASES = {
 
         sys.exit(42)
         """,
+    "durable-write": """
+        def rewrite(journal_path, rows):
+            with open(journal_path, "w") as f:
+                f.write(rows)
+        """,
 }
 
 
@@ -377,6 +391,7 @@ def test_cli_list_rules_names_the_full_set():
         "traced-mutation",
         "thread-lifecycle",
         "device-probe-before-distributed-init",
+        "durable-write",
         "lock-order-inversion",
         "blocking-under-lock",
         "signal-handler-unsafe",

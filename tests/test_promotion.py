@@ -584,6 +584,98 @@ def test_replay_tolerates_torn_final_line(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Staging-dir GC: bounded retention, journaled retired rows, kill-safe
+# ---------------------------------------------------------------------------
+
+
+def staged_names(daemon):
+    return sorted(os.listdir(daemon.config.staging_dir))
+
+
+def test_staging_gc_bounds_dir_to_lkg_plus_retained(tmp_path):
+    """Five promoted epochs with ``retain_staged=1``: the staging dir ends
+    at lkg + 1 newest other copy, every pruned copy left a journaled
+    ``retired`` row naming its digest, and replay stays idempotent."""
+    watch = tmp_path / "saved_models"
+    for epoch in range(5):
+        write_candidate(watch, epoch=epoch, val_acc=0.5 + 0.05 * epoch)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target, retain_staged=1)
+    daemon.run_once()
+    assert len(target.promoted) == 5
+    names = staged_names(daemon)
+    assert os.path.basename(daemon._lkg["staged"]) in names
+    assert len(names) <= 2, names
+    retired = [
+        r for r in PromotionJournal.load(daemon.config.journal_path)
+        if r["phase"] == promo.PHASE_RETIRED
+    ]
+    assert len(retired) >= 3
+    assert all(r.get("staged") and r.get("digest") for r in retired), retired
+    # Retired rows are audit-only on replay: a fresh daemon resumes with
+    # nothing in flight and re-promotes nothing.
+    daemon2 = make_daemon(tmp_path, target, retain_staged=1)
+    daemon2.run_once()
+    assert len(target.promoted) == 5
+
+
+def test_staging_gc_survives_mid_prune_sigkill(tmp_path, monkeypatch):
+    """SIGKILL between the ``retired`` row and the unlink: the orphaned
+    copy is still on disk at restart, the next pass re-retires it
+    (journal-then-act is idempotent), and no candidate is ever skipped
+    or double-promoted."""
+    watch = tmp_path / "saved_models"
+    for epoch in range(4):
+        write_candidate(watch, epoch=epoch, val_acc=0.5 + 0.05 * epoch)
+    target = StubTarget()
+    daemon = make_daemon(tmp_path, target, retain_staged=0)
+    _kill_at_phase(monkeypatch, promo.KILL_MID_GC)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+    retired = [
+        r for r in PromotionJournal.load(daemon.config.journal_path)
+        if r["phase"] == promo.PHASE_RETIRED
+    ]
+    assert len(retired) == 1
+    orphan = retired[0]["staged"]
+    assert orphan in staged_names(daemon), (
+        "journal-then-act: the row must land BEFORE the unlink"
+    )
+    monkeypatch.setattr(promo.faultinject, "daemon_phase", lambda p: None)
+    daemon2 = make_daemon(tmp_path, target, retain_staged=0)
+    daemon2.run_once()
+    assert len(target.promoted) == 4, "a mid-GC kill may not skip candidates"
+    names = staged_names(daemon2)
+    assert names == [os.path.basename(daemon2._lkg["staged"])], names
+    rows = PromotionJournal.load(daemon2.config.journal_path)
+    assert [
+        r["staged"] for r in rows if r["phase"] == promo.PHASE_RETIRED
+    ].count(orphan) >= 2, "the orphan must be re-retired on the next pass"
+    digest = checkpoint_digest(target.promoted[-1])
+    assert phases_for(daemon2.config.journal_path, digest)[-1] == "slo_ok"
+
+
+def test_replay_retired_rows_are_audit_only():
+    """A ``retired`` row must neither resurrect a resolved digest as
+    in-flight nor corrupt its recorded staged path (the row's ``staged``
+    is a basename)."""
+    rows = [
+        {"t": 1.0, "phase": "start", "digest": "d1", "path": "p",
+         "staged": "/stage/s1", "epoch": 0},
+        {"t": 2.0, "phase": "verified", "digest": "d1", "val_stat": 0.5},
+        {"t": 3.0, "phase": "promoted", "digest": "d1", "state_version": 1},
+        {"t": 4.0, "phase": "slo_ok", "digest": "d1"},
+        {"t": 5.0, "phase": "retired", "digest": "d1", "staged": "s1"},
+        {"t": 6.0, "phase": "retired", "digest": None, "staged": "zz"},
+    ]
+    state = replay_journal(rows)
+    assert state["inflight"] is None
+    assert "d1" in state["terminal"]
+    assert state["info"]["d1"]["staged"] == "/stage/s1"
+    assert state["lkg"]["digest"] == "d1"
+
+
+# ---------------------------------------------------------------------------
 # Post-promotion SLO watch + automatic rollback
 # ---------------------------------------------------------------------------
 

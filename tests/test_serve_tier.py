@@ -1,0 +1,442 @@
+"""Durable serving tier: crash-consistent artifact spill, integrity-fenced
+AOT executable cache, and consistent-hash fleet routing (ISSUE 18).
+
+The acceptance contract: a respawned replica pointed at its tier dir
+rehydrates its hot set (first repeat request is a cache hit, no re-adapt)
+and performs ZERO XLA compiles under ``compile_guard``; every injected
+durability fault (torn spill write, bit-flipped entry, stale executable
+fence) degrades to quarantine + the cold path with typed telemetry —
+never a crash, never a wrong answer.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    GradientDescentLearner,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    MatchingNetsLearner,
+)
+from howtotrainyourmamlpytorch_tpu.serve import (
+    PoolConfig,
+    ReplicaPool,
+    ServeConfig,
+    ServingAPI,
+)
+from howtotrainyourmamlpytorch_tpu.serve.resilience import LocalReplica
+from howtotrainyourmamlpytorch_tpu.serve.tier import (
+    ArtifactSpill,
+    ExecutableCache,
+    HashRing,
+    atomic_write_bytes,
+    build_fence,
+    serialization_available,
+)
+from howtotrainyourmamlpytorch_tpu.utils import faultinject
+
+LEARNER_CLASSES = {
+    "maml": MAMLFewShotLearner,
+    "gradient_descent": GradientDescentLearner,
+    "matching_nets": MatchingNetsLearner,
+}
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            image_height=8,
+            image_width=8,
+            num_classes=5,
+            per_step_bn_statistics=True,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+    )
+    defaults.update(kw)
+    return MAMLConfig(**defaults)
+
+
+def make_api(tier_dir, learner_cls=MAMLFewShotLearner, **serve_kw):
+    learner = learner_cls(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    defaults = dict(meta_batch_size=2, max_wait_ms=0.0)
+    defaults.update(serve_kw)
+    return ServingAPI(
+        learner, state, ServeConfig(tier_dir=str(tier_dir), **defaults)
+    )
+
+
+def episode(rng, way=5, shot=1, query=3):
+    img = (1, 8, 8)
+    xs = rng.rand(way * shot, *img).astype(np.float32)
+    ys = np.repeat(np.arange(way), shot).astype(np.int32)
+    xq = rng.rand(query, *img).astype(np.float32)
+    return xs, ys, xq
+
+
+def toy_artifact(rng):
+    return {
+        "w": rng.rand(3, 4).astype(np.float32),
+        "b": [rng.rand(4).astype(np.float32), np.int32(7)],
+    }
+
+
+def digest_of(i: int) -> str:
+    return f"{i:064x}"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.deactivate()
+    yield
+    faultinject.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# Atomic writer + artifact spill primitives
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_leaves_no_temp_residue(tmp_path):
+    path = tmp_path / "sub" / "artifact.bin"
+    atomic_write_bytes(str(path), b"payload")
+    assert path.read_bytes() == b"payload"
+    assert [p.name for p in path.parent.iterdir()] == ["artifact.bin"]
+
+
+def test_spill_round_trip_bit_exact(tmp_path):
+    rng = np.random.RandomState(0)
+    spill = ArtifactSpill(str(tmp_path))
+    artifact = toy_artifact(rng)
+    assert spill.put(digest_of(1), artifact, learner="maml", state_version=0)
+    back = spill.get(digest_of(1), learner="maml", state_version=0)
+    assert back is not None
+    orig_leaves, orig_def = jax.tree_util.tree_flatten(artifact)
+    back_leaves, back_def = jax.tree_util.tree_flatten(back)
+    assert orig_def == back_def
+    for a, b in zip(orig_leaves, back_leaves):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    assert spill.stats["hits"] == 1 and spill.stats["writes"] == 1
+
+
+def test_spill_version_or_learner_mismatch_is_a_skip_not_a_quarantine(tmp_path):
+    rng = np.random.RandomState(1)
+    spill = ArtifactSpill(str(tmp_path))
+    spill.put(digest_of(2), toy_artifact(rng), learner="maml", state_version=0)
+    assert spill.get(digest_of(2), learner="maml", state_version=1) is None
+    assert spill.get(digest_of(2), learner="gradient_descent",
+                     state_version=0) is None
+    assert spill.stats["mismatch_skipped"] == 2
+    assert spill.stats["corrupt_quarantined"] == 0
+    # The entry is intact — a matching reader still gets it.
+    assert spill.get(digest_of(2), learner="maml", state_version=0) is not None
+
+
+def test_spill_prune_bounds_entry_count(tmp_path):
+    rng = np.random.RandomState(2)
+    spill = ArtifactSpill(str(tmp_path), max_entries=2)
+    for i in range(5):
+        spill.put(digest_of(i), toy_artifact(rng), learner="maml",
+                  state_version=0)
+        time.sleep(0.01)  # distinct mtimes so prune order is deterministic
+    assert len(spill.entries()) <= 2
+    assert spill.stats["pruned"] >= 3
+    # The newest entry survives.
+    assert spill.get(digest_of(4), learner="maml", state_version=0) is not None
+
+
+# ---------------------------------------------------------------------------
+# Fault hooks: torn write / bit flip / stale fence -> quarantine + cold path
+# ---------------------------------------------------------------------------
+
+
+def test_torn_spill_write_is_quarantined_on_read(tmp_path):
+    rng = np.random.RandomState(3)
+    spill = ArtifactSpill(str(tmp_path))
+    faultinject.activate(faultinject.FaultPlan(torn_spill_write_at=1))
+    spill.put(digest_of(7), toy_artifact(rng), learner="maml", state_version=0)
+    assert any(e.startswith("torn-spill:") for e in faultinject.events)
+    faultinject.deactivate()
+    assert spill.get(digest_of(7), learner="maml", state_version=0) is None
+    assert spill.stats["corrupt_quarantined"] == 1
+    assert os.path.exists(spill.path_for(digest_of(7)) + ".corrupt")
+    assert not os.path.exists(spill.path_for(digest_of(7)))
+
+
+def test_corrupt_cache_entry_is_quarantined_on_read(tmp_path):
+    rng = np.random.RandomState(4)
+    spill = ArtifactSpill(str(tmp_path))
+    spill.put(digest_of(9), toy_artifact(rng), learner="maml", state_version=0)
+    faultinject.activate(faultinject.FaultPlan(corrupt_cache_entry_at=1))
+    assert spill.get(digest_of(9), learner="maml", state_version=0) is None
+    assert any(e.startswith("corrupt-entry:") for e in faultinject.events)
+    assert spill.stats["corrupt_quarantined"] == 1
+    assert os.path.exists(spill.path_for(digest_of(9)) + ".corrupt")
+
+
+def test_corrupt_entry_degrades_to_cold_adapt_same_answer(tmp_path, rng):
+    """A bit-flipped spill entry must cost only the re-adapt: the respawned
+    replica quarantines it, falls back to the cold path, and answers the
+    request with the SAME logits the warm path would have produced."""
+    xs, ys, xq = episode(rng)
+    api1 = make_api(tmp_path)
+    try:
+        warm = api1.classify(xs, ys, xq)
+    finally:
+        api1.close()
+    faultinject.activate(faultinject.FaultPlan(corrupt_cache_entry_at=1))
+    api2 = make_api(tmp_path)
+    try:
+        cold = api2.classify(xs, ys, xq)
+        stats = api2.engine.tier_stats()
+    finally:
+        api2.close()
+    assert not cold["cache_hit"], "corrupt entry must not serve as a hit"
+    np.testing.assert_array_equal(
+        np.asarray(warm["logits"]), np.asarray(cold["logits"])
+    )
+    assert stats["spill"]["corrupt_quarantined"] == 1
+
+
+@pytest.mark.skipif(
+    not serialization_available(), reason="jax serialize_executable missing"
+)
+def test_stale_exec_fence_recompiles_instead_of_running_wrong_code(
+    tmp_path, rng, compile_guard
+):
+    xs, ys, xq = episode(rng)
+    api1 = make_api(tmp_path)
+    try:
+        api1.engine.warmup([(5, 1, 3)])
+        want = api1.classify(xs, ys, xq)
+    finally:
+        api1.close()
+    faultinject.activate(
+        faultinject.FaultPlan(stale_exec_cache_at=1)
+    )
+    with compile_guard() as guard:
+        api2 = make_api(tmp_path)
+        try:
+            api2.engine.warmup([(5, 1, 3)])
+            got = api2.classify(xs, ys, xq)
+            stats = api2.engine.tier_stats()
+        finally:
+            api2.close()
+    assert "stale-exec-fence" in faultinject.events
+    assert stats["exec"]["stale"] >= 1
+    # The stale executable was rejected -> at least one REAL compile.
+    assert len(guard.events) >= 1
+    np.testing.assert_array_equal(
+        np.asarray(want["logits"]), np.asarray(got["logits"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# Warm respawn: rehydrated hot set + zero XLA compiles
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", sorted(LEARNER_CLASSES))
+def test_respawn_first_repeat_request_hits_without_readapt(tmp_path, family):
+    """All three learner families: the artifact a replica spilled is
+    rehydrated bit-exact by its successor — the first repeat request is a
+    cache hit with identical logits, no inner loop run."""
+    rng = np.random.RandomState(5)
+    xs, ys, xq = episode(rng)
+    api1 = make_api(tmp_path, learner_cls=LEARNER_CLASSES[family])
+    try:
+        first = api1.classify(xs, ys, xq)
+        assert not first["cache_hit"]
+    finally:
+        api1.close()
+    api2 = make_api(tmp_path, learner_cls=LEARNER_CLASSES[family])
+    try:
+        again = api2.classify(xs, ys, xq)
+    finally:
+        api2.close()
+    assert again["cache_hit"], "rehydrated digest must hit, not re-adapt"
+    np.testing.assert_array_equal(
+        np.asarray(first["logits"]), np.asarray(again["logits"])
+    )
+
+
+@pytest.mark.skipif(
+    not serialization_available(), reason="jax serialize_executable missing"
+)
+def test_warm_respawn_performs_zero_xla_compiles(tmp_path, rng, compile_guard):
+    """THE acceptance gate: construct + warm up + serve a fresh engine on a
+    primed tier dir entirely under ``compile_guard`` — zero compile events,
+    and the answers are bit-exact with the cold engine's."""
+    xs, ys, xq = episode(rng)
+    learner = MAMLFewShotLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    cfg = ServeConfig(meta_batch_size=2, max_wait_ms=0.0,
+                      tier_dir=str(tmp_path))
+    api1 = ServingAPI(learner, state, cfg)
+    try:
+        api1.engine.warmup([(5, 1, 3)])
+        want = api1.classify(xs, ys, xq)
+    finally:
+        api1.close()
+    with compile_guard() as guard:
+        api2 = ServingAPI(learner, state, cfg)
+        try:
+            api2.engine.warmup([(5, 1, 3)])
+            got = api2.classify(xs, ys, xq)
+            stats = api2.engine.tier_stats()
+        finally:
+            api2.close()
+    assert guard.events == [], (
+        "warm respawn compiled: "
+        + ", ".join(e.name for e in guard.events)
+    )
+    assert got["cache_hit"]
+    assert stats["aot_programs"] >= 2  # adapt + classify came from disk
+    np.testing.assert_array_equal(
+        np.asarray(want["logits"]), np.asarray(got["logits"])
+    )
+
+
+def test_exec_cache_fence_names_the_build_provenance(tmp_path):
+    fence = build_fence("serve_adapt_maml", "adapt;float32:(5, 1, 8, 8)")
+    for field in ("jax", "jaxlib", "backend", "device_kind", "program",
+                  "signature", "donation", "sharding"):
+        assert field in fence, fence
+    cache = ExecutableCache(str(tmp_path))
+    assert cache.get("serve_adapt_maml", "sig") is None
+    assert cache.stats["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_spreads_keys_and_routes_deterministically():
+    ring = HashRing()
+    for node in (0, 1, 2):
+        ring.add(node)
+    keys = [f"episode-{i}" for i in range(300)]
+    owners = {k: ring.route(k) for k in keys}
+    by_node = {n: sum(1 for o in owners.values() if o == n) for n in (0, 1, 2)}
+    assert all(count > 0 for count in by_node.values()), by_node
+    assert {ring.route(k) for k in keys for _ in range(2)} == {0, 1, 2}
+    assert all(ring.route(k) == owners[k] for k in keys)
+
+
+def test_ring_removal_moves_only_the_dead_nodes_keys():
+    ring = HashRing()
+    for node in (0, 1, 2):
+        ring.add(node)
+    keys = [f"episode-{i}" for i in range(300)]
+    before = {k: ring.route(k) for k in keys}
+    ring.remove(1)
+    assert 1 not in ring and len(ring) == 2
+    moved = [k for k in keys if ring.route(k) != before[k]]
+    assert set(moved) == {k for k in keys if before[k] == 1}, (
+        "a retirement may only re-home the dead node's keys"
+    )
+    succ = ring.successor(1)
+    assert succ in (0, 2)
+
+
+def test_ring_empty_routes_none():
+    ring = HashRing()
+    assert ring.route("anything") is None
+    ring.add(3)
+    assert ring.route("anything") == 3
+    ring.remove(3)
+    assert ring.route("anything") is None
+
+
+# ---------------------------------------------------------------------------
+# Fleet routing + dead-replica spill adoption
+# ---------------------------------------------------------------------------
+
+
+def tier_pool(tier_root, n=2, **pool_kw):
+    def factory(index: int) -> LocalReplica:
+        api = make_api(os.path.join(str(tier_root), f"replica-{index}"))
+        api.engine.warmup([(5, 1, 3)])
+        return LocalReplica(api, replica_id=f"local-{index}")
+
+    defaults = dict(
+        n_replicas=n,
+        health_interval_s=0.02,
+        health_timeout_s=1.0,
+        unhealthy_after=2,
+        restart_backoff_s=0.02,
+        restart_backoff_max_s=1.0,
+        min_uptime_s=0.0,
+        route_by_digest=True,
+        tier_root=str(tier_root),
+    )
+    defaults.update(pool_kw)
+    pool = ReplicaPool(factory, PoolConfig(**defaults))
+    assert pool.wait_ready(timeout=120.0), "pool never became healthy"
+    return pool
+
+
+def test_pool_digest_affinity_repeat_traffic_all_hits(tmp_path, rng):
+    pool = tier_pool(tmp_path)
+    try:
+        episodes = [episode(rng) for _ in range(6)]
+        for xs, ys, xq in episodes:
+            pool.classify(xs, ys, xq)
+        # Same digests route to the same replicas: every repeat is a hit.
+        for xs, ys, xq in episodes:
+            out = pool.classify(xs, ys, xq)
+            assert out["cache_hit"], "digest affinity broke: repeat missed"
+        assert pool.stats()["ring_nodes"] == 2
+        assert pool.stats()["replica_ready_s"] is not None
+    finally:
+        pool.close()
+
+
+def test_killed_replica_spill_adopted_by_successor(tmp_path, rng):
+    """SIGKILL-equivalent death under traffic: the request is still
+    answered, the ring re-forms, the successor rehydrates the dead
+    replica's spill dir, and the dead replica's episodes keep hitting."""
+    pool = tier_pool(tmp_path)
+    try:
+        episodes = [episode(rng) for _ in range(6)]
+        for xs, ys, xq in episodes:
+            out = pool.classify(xs, ys, xq)
+            assert "logits" in out
+        faultinject.activate(
+            faultinject.FaultPlan(replica_kill_at_request=1)
+        )
+        out = pool.classify(*episodes[0][:3])  # kills a replica; re-dispatched
+        assert "logits" in out
+        faultinject.deactivate()
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            s = pool.stats()
+            if s["rehydrations_total"] >= 1 and s["ring_nodes"] == 2:
+                break
+            time.sleep(0.02)
+        s = pool.stats()
+        assert s["replica_deaths_total"] >= 1
+        assert s["rehydrations_total"] >= 1, s
+        assert s["ring_nodes"] == 2, s
+        # Every pre-death episode still hits: the successor adopted the
+        # dead replica's artifacts, nothing re-adapts.
+        for xs, ys, xq in episodes:
+            assert pool.classify(xs, ys, xq)["cache_hit"]
+        assert pool.stats()["request_errors"] == 0
+        text = pool.metrics_text()
+        assert "_rehydrations_total" in text
+        assert "_replica_ready_s" in text
+    finally:
+        pool.close()

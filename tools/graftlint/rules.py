@@ -1,4 +1,4 @@
-"""The graftlint rule set — 8 JAX-specific hazard detectors.
+"""The graftlint rule set — the AST-level hazard detectors.
 
 Every rule yields :class:`~tools.graftlint.core.Violation` objects and is
 registered in :data:`ALL_RULES`. Rules are heuristics tuned against this
@@ -10,6 +10,7 @@ each carries at least one positive and one negative unit test in
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Iterator
 
@@ -789,6 +790,92 @@ class DeviceProbeBeforeDistributedInitRule(Rule):
                     )
 
 
+class DurableWriteRule(Rule):
+    id = "durable-write"
+    summary = (
+        "a journal/spill/durable-tier path is opened with a truncating "
+        "mode via bare open() — a crash mid-write leaves a torn file "
+        "where the durability contract promises old-or-new; route the "
+        "write through serve/tier/atomic.atomic_write_bytes "
+        "(tmp + fsync + rename)"
+    )
+
+    #: Substrings of the path-argument SOURCE that mark a durable
+    #: artifact tree-wide. Deliberately narrow ("journal", "spill" — not
+    #: "logs"): debug/log sinks are rewrite-on-start by design and a
+    #: torn log line costs nothing, while a torn journal or spill entry
+    #: silently corrupts recovery state.
+    DURABLE_MARKERS = ("journal", "spill")
+
+    #: Inside ``serve/tier/`` every truncating open is a violation
+    #: regardless of variable naming — except the atomic helper itself,
+    #: which is the one sanctioned writer.
+    TIER_FRAGMENT = "serve/tier/"
+    TIER_EXEMPT_BASENAME = "atomic.py"
+
+    @staticmethod
+    def _mode_literal(call: ast.Call) -> str | None:
+        """The call's mode if it is a string literal (positional #2 or
+        ``mode=``); None when absent or dynamic — a computed mode is out
+        of scope for a false-positive-averse rule."""
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            return call.args[1].value
+        return None
+
+    def check(self, module, project):
+        norm = module.path.replace("\\", "/")
+        in_tier = (
+            self.TIER_FRAGMENT in norm
+            and os.path.basename(norm) != self.TIER_EXEMPT_BASENAME
+        )
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # Builtin open() only: Path.open()/os.open() carry different
+            # semantics and naming them would multiply false positives.
+            if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+                continue
+            mode = self._mode_literal(node)
+            if mode is None or "w" not in mode:
+                continue  # default "r", appends, and dynamic modes pass
+            if in_tier:
+                yield self._v(
+                    module,
+                    node,
+                    f"truncating open(..., {mode!r}) inside serve/tier/ — "
+                    "every durable-tier write must go through "
+                    "atomic_write_bytes (tmp + fsync + rename) so a crash "
+                    "leaves old-or-new, never a torn file",
+                )
+                continue
+            path_arg = node.args[0] if node.args else None
+            if path_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "file":
+                        path_arg = kw.value
+                        break
+            if path_arg is None:
+                continue
+            try:
+                src = ast.unparse(path_arg).lower()
+            except Exception:
+                continue
+            if any(marker in src for marker in self.DURABLE_MARKERS):
+                yield self._v(
+                    module,
+                    node,
+                    f"truncating open(..., {mode!r}) on a path naming a "
+                    "journal/spill artifact — durable state must be "
+                    "written via atomic_write_bytes (tmp + fsync + "
+                    "rename) or appended, never rewritten in place",
+                )
+
+
 ALL_RULES: list[Rule] = [
     PRNGReuseRule(),
     HostNumpyInTraceRule(),
@@ -800,6 +887,7 @@ ALL_RULES: list[Rule] = [
     TracedMutationRule(),
     ThreadLifecycleRule(),
     DeviceProbeBeforeDistributedInitRule(),
+    DurableWriteRule(),
 ]
 
 # The whole-program concurrency/contract rules (graftlint v2) live in

@@ -18,7 +18,12 @@ This harness offers exactly that:
   (pool: healthy replicas below size; single engine: ``ready`` false), and
   ``serve_recovery_s`` reports the longest one — with
   ``--kill-replica-at K`` it is the measured replica-death-to-full-health
-  time under live traffic.
+  time under live traffic;
+* **durable tier** — ``--tier-dir DIR`` gives every replica a
+  crash-consistent tier at ``DIR/replica-<i>`` (artifact spill + AOT
+  executable cache) and digest-affine ring routing; a killed replica then
+  respawns WARM (rehydrate, not recompile) and ``serve_replica_ready_s``
+  reports the measured factory-to-HEALTHY time of the newest respawn.
 
 Targets: in-process single engine (default; ``--tiny`` for the CI-sized
 model), in-process supervised replica pool (``--replicas N``), or any
@@ -217,6 +222,17 @@ def run_loadtest(
         else None
     )
 
+    # Durable-tier receipt: a pool target reports how long its most
+    # recent replica took from factory start to HEALTHY — with a warm
+    # tier (--tier-dir) this is the rehydrate-not-recompile respawn time.
+    replica_ready_s = None
+    stats_fn = getattr(target, "stats", None)
+    if callable(stats_fn):
+        try:
+            replica_ready_s = stats_fn().get("replica_ready_s")
+        except Exception:
+            replica_ready_s = None
+
     offered = len(arrivals)
     by_outcome = {k: 0 for k in (
         OUTCOME_OK, OUTCOME_SHED, OUTCOME_DEADLINE, OUTCOME_ERROR,
@@ -250,6 +266,9 @@ def run_loadtest(
         ) if offered else 0.0,
         "serve_error_slo": error_slo,
         "serve_recovery_s": recovery_s,
+        "serve_replica_ready_s": (
+            round(replica_ready_s, 3) if replica_ready_s is not None else None
+        ),
         "slo_pass": slo_pass,
         "duration_s": round(wall_s, 3),
     }
@@ -273,9 +292,20 @@ def _build_local_target(opts):
     )
     from tools.serve_bench import build_api
 
-    def one_api():
+    tier_dir = getattr(opts, "tier_dir", None)
+
+    def replica_tier(index: int):
+        # Per-replica tier layout matches PoolConfig.tier_root: a
+        # restarted slot reuses its dir (warm respawn), a retired slot's
+        # dir is rehydrated by its ring successor.
+        if not tier_dir:
+            return None
+        return os.path.join(tier_dir, f"replica-{index}")
+
+    def one_api(replica_tier_dir=None):
         api = build_api(
-            opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512
+            opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512,
+            tier_dir=replica_tier_dir,
         )
         way = api.engine.learner.cfg.backbone.num_classes
         api.engine.warmup([(way, opts.shot, opts.query)])
@@ -284,11 +314,14 @@ def _build_local_target(opts):
     if opts.replicas > 0:
         # Slot 0's engine doubles as the geometry source (slots start in
         # order at pool construction); restarts build fresh ones.
-        prebuilt = [one_api()]
+        prebuilt = [one_api(replica_tier(0))]
         backbone = prebuilt[0].engine.learner.cfg.backbone
 
         def factory(index: int) -> LocalReplica:
-            api = prebuilt.pop() if prebuilt else one_api()
+            if index == 0 and prebuilt:
+                api = prebuilt.pop()
+            else:
+                api = one_api(replica_tier(index))
             return LocalReplica(api, replica_id=f"local-{index}")
 
         pool = ReplicaPool(
@@ -298,6 +331,8 @@ def _build_local_target(opts):
                 health_interval_s=0.1,
                 restart_backoff_s=0.1,
                 min_uptime_s=0.5,
+                tier_root=tier_dir or None,
+                route_by_digest=bool(tier_dir),
             ),
         )
         if not pool.wait_ready(timeout=300.0):
@@ -307,7 +342,7 @@ def _build_local_target(opts):
                 "offer load to a dead fleet"
             )
         return pool, backbone
-    api = one_api()
+    api = one_api(tier_dir or None)
     return api, api.engine.learner.cfg.backbone
 
 
@@ -346,6 +381,11 @@ def main(argv=None) -> int:
     parser.add_argument("--kill-replica-at", type=int, default=None,
                         help="inject replica death at the Kth request "
                         "(in-process targets) and measure recovery")
+    parser.add_argument("--tier-dir", default=None,
+                        help="durable-tier root for in-process targets: "
+                        "replica i spills to <dir>/replica-<i>, the pool "
+                        "routes by episode digest, and a killed replica "
+                        "respawns warm from its tier")
     parser.add_argument("--json", action="store_true",
                         help="print the result as one JSON line")
     opts = parser.parse_args(argv)
@@ -414,7 +454,8 @@ def main(argv=None) -> int:
             f"{result['serve_loadtest_p99_ms']} ms (budget "
             f"{result['serve_slo_p99_ms']}), error rate "
             f"{result['serve_error_rate']} (slo {result['serve_error_slo']})"
-            f", recovery {result['serve_recovery_s']} s"
+            f", recovery {result['serve_recovery_s']} s, replica ready "
+            f"{result['serve_replica_ready_s']} s"
         )
     return 0 if result["slo_pass"] else 2
 
