@@ -11,12 +11,15 @@ from .augment import augment_image, get_transforms_for_dataset, rotate_image
 from .dataset import FewShotLearningDataset
 from .device_prefetch import DevicePrefetcher
 from .loader import MetaLearningSystemDataLoader
+from .synth_geometry import geometry_mix_episodes, synthesize_episode
 
 __all__ = [
     "DevicePrefetcher",
     "FewShotLearningDataset",
     "MetaLearningSystemDataLoader",
     "augment_image",
+    "geometry_mix_episodes",
     "get_transforms_for_dataset",
     "rotate_image",
+    "synthesize_episode",
 ]

@@ -42,7 +42,10 @@ def load_replay_manifest(path: str) -> tuple[int, ...]:
     """Mined hard-episode seeds from a ``tools/episode_miner.py`` replay
     manifest, in manifest order (hardest first). Fail-fast on a missing/
     malformed file — a training run silently dropping its curriculum is
-    worse than refusing to start."""
+    worse than refusing to start. Optional provenance keys the miner adds
+    (e.g. ``learner``, the serving family the seeds were mined from) are
+    deliberately ignored: a hard episode is a hard episode, whichever
+    family surfaced it."""
     with open(path) as f:
         manifest = json.load(f)
     if int(manifest.get("schema", -1)) > REPLAY_MANIFEST_SCHEMA:

@@ -1,0 +1,86 @@
+"""Seeded synthetic episodes at ARBITRARY (way, shot, query) geometry.
+
+The serving stack's geometry subsystem (``serve/geometry.py``) exists to
+absorb heterogeneous episode shapes, which means its tests, load harness
+(``tools/serve_loadtest.py --geometry-mix``) and bench
+(``tools/serve_bench.py``) all need a stream of well-formed episodes whose
+geometry VARIES per episode — something the training pipeline (fixed
+``(way, shot)`` per run) never produces. This module is that generator:
+pure NumPy, seed-deterministic (same seed → byte-identical episodes, the
+property every parity/compile-count assertion leans on), and structured
+rather than pure noise — per-class mean offsets make the classes actually
+separable, so a served model's logits are non-degenerate and a NaN-poisoned
+checkpoint cannot hide behind symmetric garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["synthesize_episode", "geometry_mix_episodes"]
+
+
+def synthesize_episode(
+    way: int,
+    shot: int,
+    query: int,
+    *,
+    image_shape: tuple[int, int, int],
+    seed: int = 0,
+):
+    """One class-uniform ``(x_support, y_support, x_query)`` episode.
+
+    Support is ``(way*shot, C, H, W)`` float32 in class order (class c's
+    rows are ``c*shot .. (c+1)*shot``), labels ``(way*shot,)`` int32,
+    queries ``(query, C, H, W)`` drawn round-robin from the same class
+    means — every array a valid ``ServingEngine.prepare_episode`` input at
+    exactly the requested geometry."""
+    way, shot, query = int(way), int(shot), int(query)
+    if min(way, shot, query) < 1:
+        raise ValueError(
+            f"episode geometry must be positive, got {(way, shot, query)}"
+        )
+    rng = np.random.RandomState(seed)
+    img = tuple(int(d) for d in image_shape)
+    # Per-class structure: a distinct mean image per class + small noise,
+    # in [0, 1] like real pipeline output.
+    means = rng.rand(way, *img).astype(np.float32)
+    xs = np.clip(
+        np.repeat(means, shot, axis=0)
+        + 0.05 * rng.randn(way * shot, *img).astype(np.float32),
+        0.0, 1.0,
+    ).astype(np.float32)
+    ys = np.repeat(np.arange(way), shot).astype(np.int32)
+    q_classes = np.arange(query) % way
+    xq = np.clip(
+        means[q_classes]
+        + 0.05 * rng.randn(query, *img).astype(np.float32),
+        0.0, 1.0,
+    ).astype(np.float32)
+    return xs, ys, xq
+
+
+def geometry_mix_episodes(
+    n: int,
+    mix: Sequence[Sequence[int]],
+    *,
+    image_shape: tuple[int, int, int],
+    seed: int = 0,
+):
+    """``n`` episodes cycling a declared ``(way, shot, query)`` mix.
+
+    Episode ``i`` rides geometry ``mix[i % len(mix)]`` with seed
+    ``seed + i`` — distinct support sets (the adapt path stays honest)
+    over a deterministic geometry rotation, which is exactly the traffic
+    shape the lattice's compile-count pin is asserted against."""
+    mix = [tuple(int(d) for d in g) for g in mix]
+    if not mix:
+        raise ValueError("geometry mix must name at least one geometry")
+    return [
+        synthesize_episode(
+            *mix[i % len(mix)], image_shape=image_shape, seed=seed + i
+        )
+        for i in range(int(n))
+    ]

@@ -4,10 +4,14 @@ from .backbone import BackboneConfig, VGGBackbone, build_backbone
 from .resnet import ResNet12Backbone
 from .common import InferenceState
 from .maml import MAMLConfig, MAMLFewShotLearner, MAMLInferenceState
+from .anil import ANILConfig, ANILLearner
 from .gradient_descent import GDInferenceState, GradientDescentLearner
 from .matching_nets import MatchingNetsLearner
+from .protonets import ProtoNetsConfig, ProtoNetsLearner, ProtoNetsState
 
 __all__ = [
+    "ANILConfig",
+    "ANILLearner",
     "BackboneConfig",
     "VGGBackbone",
     "ResNet12Backbone",
@@ -19,4 +23,7 @@ __all__ = [
     "MAMLInferenceState",
     "GradientDescentLearner",
     "MatchingNetsLearner",
+    "ProtoNetsConfig",
+    "ProtoNetsLearner",
+    "ProtoNetsState",
 ]

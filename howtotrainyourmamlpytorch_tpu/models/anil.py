@@ -1,0 +1,53 @@
+"""ANIL: Almost No Inner Loop (Raghu et al., "Rapid Learning or Feature
+Reuse? Towards Understanding the Effectiveness of MAML").
+
+ANIL is MAML with the inner loop restricted to the classifier HEAD: the
+convolutional body is frozen through adaptation (pure feature reuse) but
+still meta-trained by the outer optimizer. The entire specialization lives
+in :meth:`ANILLearner.adapt_mask` — the partition seam ``maml.py`` routes
+every adapt path (train, eval, serve) and the LSLR table through — so ANIL
+inherits the full MAML++ machinery unchanged and exactly:
+
+* second-order legal: the outer gradient differentiates through the
+  head-only inner updates (same ``lax.scan`` + ``stop_gradient`` gating);
+* LSLR over head leaves only: ``init_state`` sizes the per-leaf per-step
+  learning-rate table from the partition, so it holds exactly
+  ``linear/weight`` and ``linear/bias`` rows;
+* MSL, remat, bf16 boundary cast, dp fused-collective step, mp arg-driven
+  layouts, checkpoint prefix contract, divergence sentinel — all inherited.
+
+Why it earns a serving tier: ``serve_adapt`` returns only the adapted HEAD
+leaves — a `(num_classes, feat) + (num_classes,)` artifact, kilobytes
+against MAML's full-tree fast weights — and the inner-loop backward is a
+single linear layer, not the conv stack. Same cache/digest contract as
+MAML (serve/engine.py), far cheaper per miss.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .backbone import _map_with_path
+from .maml import MAMLConfig, MAMLFewShotLearner
+
+Tree = Any
+
+__all__ = ["ANILConfig", "ANILLearner"]
+
+#: ANIL introduces no hyperparameters beyond MAML's — the head-only
+#: restriction is structural, not a config knob (a knob would let one
+#: checkpoint silently change meaning across runs).
+ANILConfig = MAMLConfig
+
+
+class ANILLearner(MAMLFewShotLearner):
+    """MAML with head-only inner-loop adaptation (frozen-body feature
+    reuse). See module docstring; every contract method is inherited."""
+
+    def adapt_mask(self, theta: Tree) -> Tree:
+        """Only the classifier head is a fast weight; the body — conv
+        stacks AND their norm params — is frozen through adaptation
+        (outer-trained like every other frozen leaf)."""
+        return _map_with_path(
+            lambda path, _leaf: path[0] == "linear", theta
+        )

@@ -680,6 +680,10 @@ PROGRAM_REGISTRY_NAMES = (
     "maml/serve_adapt",
     "gd/train_step",
     "matching/train_step",
+    "anil/train_step",
+    "anil/serve_adapt",
+    "protonets/train_step",
+    "protonets/serve_adapt",
 )
 
 
@@ -731,9 +735,11 @@ def registered_programs() -> "list[ProgramSpec]":
     learners are only imported (and tiny instances only built) when
     called, so jax-free consumers can import this module without paying
     for it."""
+    from .anil import ANILLearner
     from .gradient_descent import GradientDescentLearner
     from .maml import BackboneConfig, MAMLConfig, MAMLFewShotLearner
     from .matching_nets import MatchingNetsLearner
+    from .protonets import ProtoNetsLearner
 
     n_devices = len(jax.devices())
 
@@ -806,16 +812,33 @@ def registered_programs() -> "list[ProgramSpec]":
         fn = learner._get_eval_step(final_only=True)
         return fn, (state, batch, importance)
 
-    def maml_serve():
-        learner = MAMLFewShotLearner(maml_cfg())
-        istate = learner.init_inference_state(jax.random.PRNGKey(0))
-        xs, _, ys, _ = _tiny_episode_batch()
-        # One task's flat support set, the engine's wire shape:
-        # (S, C, H, W) images and (S,) int32 labels (serve/engine.py).
-        x_support = jnp.asarray(xs[0]).reshape(-1, 1, 8, 8)
-        y_support = jnp.asarray(ys[0], jnp.int32).reshape(-1)
-        fn = jax.jit(learner.serve_adapt)
-        return fn, (istate, x_support, y_support)
+    def serve_build(learner_cls):
+        def build():
+            learner = learner_cls(maml_cfg())
+            istate = learner.init_inference_state(jax.random.PRNGKey(0))
+            xs, _, ys, _ = _tiny_episode_batch()
+            # One task's flat support set, the engine's wire shape:
+            # (S, C, H, W) images and (S,) int32 labels (serve/engine.py).
+            x_support = jnp.asarray(xs[0]).reshape(-1, 1, 8, 8)
+            y_support = jnp.asarray(ys[0], jnp.int32).reshape(-1)
+            fn = jax.jit(learner.serve_adapt)
+            return fn, (istate, x_support, y_support)
+
+        return build
+
+    maml_serve = serve_build(MAMLFewShotLearner)
+
+    def anil_train():
+        def build():
+            mesh = dp_mesh() if n_devices >= 2 else None
+            learner = ANILLearner(maml_cfg(), mesh=mesh)
+            state = learner.init_state(jax.random.PRNGKey(0))
+            batch = learner._prepare_batch(_tiny_episode_batch())
+            importance = jnp.asarray(learner._train_importance(100))
+            fn = learner._get_train_step(second_order=True, final_only=True)
+            return fn, (state, batch, importance)
+
+        return build
 
     def baseline_train(learner_cls):
         def build():
@@ -864,6 +887,32 @@ def registered_programs() -> "list[ProgramSpec]":
             build=baseline_train(MatchingNetsLearner),
             collective_budget=MatchingNetsLearner.collective_budget,
             donate=True,
+        ),
+        ProgramSpec(
+            name="anil/train_step",
+            source="howtotrainyourmamlpytorch_tpu/models/anil.py",
+            build=anil_train(),
+            collective_budget=ANILLearner.collective_budget,
+            donate=True,
+        ),
+        ProgramSpec(
+            name="anil/serve_adapt",
+            source="howtotrainyourmamlpytorch_tpu/models/anil.py",
+            build=serve_build(ANILLearner),
+            collective_budget=ANILLearner.collective_budget,
+        ),
+        ProgramSpec(
+            name="protonets/train_step",
+            source="howtotrainyourmamlpytorch_tpu/models/protonets.py",
+            build=baseline_train(ProtoNetsLearner),
+            collective_budget=ProtoNetsLearner.collective_budget,
+            donate=True,
+        ),
+        ProgramSpec(
+            name="protonets/serve_adapt",
+            source="howtotrainyourmamlpytorch_tpu/models/protonets.py",
+            build=serve_build(ProtoNetsLearner),
+            collective_budget=ProtoNetsLearner.collective_budget,
         ),
     ]
     if n_devices >= 4:

@@ -33,7 +33,7 @@ import numpy as np
 import optax
 from jax import lax
 
-from ..ops import accuracy, cross_entropy
+from ..ops import accuracy, cross_entropy, masked_cross_entropy
 from .backbone import build_backbone
 from .common import (
     CheckpointableLearner,
@@ -342,6 +342,17 @@ class GradientDescentLearner(CheckpointableLearner):
     def serve_adapt(self, istate: GDInferenceState, x_support, y_support):
         """ONE task's support fine-tune (the eval step count), returning the
         adapted full parameter tree — this baseline's cacheable artifact."""
+        return self._serve_adapt(istate, x_support, y_support, None)
+
+    def serve_adapt_masked(
+        self, istate: GDInferenceState, x_support, y_support, support_mask
+    ):
+        """Geometry-aware twin of ``serve_adapt`` (serve/geometry.py):
+        padded support rows (``support_mask == 0``) contribute exactly
+        zero to the fine-tune loss and its gradient."""
+        return self._serve_adapt(istate, x_support, y_support, support_mask)
+
+    def _serve_adapt(self, istate, x_support, y_support, support_mask):
         backbone = self.backbone
         x_support = decode_images(x_support, self.cfg.wire_codec, self.cfg.dtype)
         opt_state = self.tx.init(istate.theta)
@@ -361,7 +372,12 @@ class GradientDescentLearner(CheckpointableLearner):
                 logits, bn1 = backbone.apply(
                     cast_floats(theta_, self.cfg.dtype), bn, x_support, 0
                 )
-                return cross_entropy(logits, y_support), bn1
+                if support_mask is None:
+                    return cross_entropy(logits, y_support), bn1
+                return (
+                    masked_cross_entropy(logits, y_support, support_mask),
+                    bn1,
+                )
 
             (_, bn), grads = jax.value_and_grad(
                 support_loss_fn, has_aux=True

@@ -43,7 +43,7 @@ import optax
 from jax import lax
 
 from ..inner_loop import init_lslr, lslr_update
-from ..ops import accuracy, cross_entropy
+from ..ops import accuracy, cross_entropy, masked_cross_entropy
 from ..utils.trees import merge, partition
 from .backbone import BackboneConfig, build_backbone
 from .common import (
@@ -560,9 +560,19 @@ class MAMLFewShotLearner(CheckpointableLearner):
     # Initialization
     # ------------------------------------------------------------------
 
+    def adapt_mask(self, theta: Tree) -> Tree:
+        """Which ``theta`` leaves the inner loop adapts (True = fast
+        weight). The single partition seam between the meta-trained
+        parameter set and the per-task fast weights: ``init_state`` sizes
+        the LSLR table from it, and every adapt path (train, eval, serve)
+        partitions through it — which is what lets ``models/anil.py``
+        restrict adaptation to the classifier head by overriding this one
+        hook."""
+        return self.backbone.inner_loop_mask(theta)
+
     def init_state(self, key: jax.Array) -> TrainState:
         theta, bn_state = self.backbone.init(key, dtype=jnp.float32)
-        mask = self.backbone.inner_loop_mask(theta)
+        mask = self.adapt_mask(theta)
         adapt, _ = partition(theta, mask)
         lslr = init_lslr(
             adapt,
@@ -671,7 +681,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         work and its second-order backward per inner step.
         """
         backbone = self.backbone
-        mask = backbone.inner_loop_mask(theta)
+        mask = self.adapt_mask(theta)
         adapt0, frozen = partition(theta, mask)
         compute_dtype = self.cfg.dtype
         # ONE boundary cast of the f32 master params to the compute dtype
@@ -1137,7 +1147,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         WITHOUT touching the optimizer — serving cold-start never builds
         (or pays host RAM for) the Adam moment trees."""
         theta, bn_state = self.backbone.init(key, dtype=jnp.float32)
-        mask = self.backbone.inner_loop_mask(theta)
+        mask = self.adapt_mask(theta)
         adapt, _ = partition(theta, mask)
         lslr = init_lslr(
             adapt,
@@ -1160,8 +1170,22 @@ class MAMLFewShotLearner(CheckpointableLearner):
         ``_task_adapt_and_losses`` under eval semantics (first order, eval's
         fused-norm gating). Returns the adapted fast-weight pytree, the
         cacheable artifact keyed by the support-set digest."""
+        return self._serve_adapt(istate, x_support, y_support, None)
+
+    def serve_adapt_masked(
+        self, istate: MAMLInferenceState, x_support, y_support, support_mask
+    ):
+        """Geometry-aware twin of ``serve_adapt`` (serve/geometry.py):
+        ``support_mask`` flags the REAL rows of a lattice-padded support
+        set. Padded rows contribute exactly zero to the inner-loop loss
+        and its gradient (``ops.masked_cross_entropy``), so with a
+        row-independent backbone the fast weights are bit-exact with an
+        unpadded dispatch of the real rows."""
+        return self._serve_adapt(istate, x_support, y_support, support_mask)
+
+    def _serve_adapt(self, istate, x_support, y_support, support_mask):
         backbone = self.backbone
-        mask = backbone.inner_loop_mask(istate.theta)
+        mask = self.adapt_mask(istate.theta)
         adapt0, frozen = partition(istate.theta, mask)
         # Same boundary cast as the eval graph (_task_adapt_and_losses), so
         # served adaptation stays bit-exact with run_validation_iter.
@@ -1177,7 +1201,12 @@ class MAMLFewShotLearner(CheckpointableLearner):
                 logits, bn1 = backbone.apply(
                     merge(fast_, frozen), bn, x_support, step, fused=fused
                 )
-                return cross_entropy(logits, y_support), bn1
+                if support_mask is None:
+                    return cross_entropy(logits, y_support), bn1
+                return (
+                    masked_cross_entropy(logits, y_support, support_mask),
+                    bn1,
+                )
 
             (_, bn1), grads = jax.value_and_grad(support_loss_fn, has_aux=True)(
                 fast
@@ -1197,7 +1226,7 @@ class MAMLFewShotLearner(CheckpointableLearner):
         BN running stats never influence outputs (``ops/norm.py``), so the
         template ``bn_state`` stands in for the adapt-evolved one."""
         backbone = self.backbone
-        mask = backbone.inner_loop_mask(istate.theta)
+        mask = self.adapt_mask(istate.theta)
         _, frozen = partition(istate.theta, mask)
         frozen = cast_floats(frozen, self.cfg.dtype)
         x_query = decode_images(x_query, self.cfg.wire_codec, self.cfg.dtype)

@@ -76,6 +76,7 @@ def cosine_attention_predictions(
     target_emb: jax.Array,
     y_support: jax.Array,
     num_classes: int,
+    support_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Attention-over-support class probabilities.
 
@@ -83,11 +84,22 @@ def cosine_attention_predictions(
     (support-side-only normalization, as in ``matching_nets.py:369-376``),
     softmax over the support axis, then mixed with one-hot support labels.
     Returns ``(T, num_classes)`` probabilities.
+
+    ``support_mask`` (episode-geometry contract, serve/geometry.py) drops
+    padded support rows out of the attention: their similarities are set
+    to ``-inf`` BEFORE the softmax, so they carry exactly zero attention
+    weight (``exp(-inf) == 0``) and contribute exact zeros to the class
+    mix — real-class probabilities match an unpadded dispatch bit-for-bit
+    on a row-independent backbone.
     """
     eps = 1e-10
     sum_sq = jnp.sum(support_emb**2, axis=-1)
     inv_mag = jax.lax.rsqrt(jnp.clip(sum_sq, eps, None))
     sims = jnp.einsum("tf,sf->ts", target_emb, support_emb) * inv_mag[None, :]
+    if support_mask is not None:
+        sims = jnp.where(
+            support_mask[None, :] > 0, sims, -jnp.inf
+        )
     attention = jax.nn.softmax(sims, axis=-1)
     onehot = jax.nn.one_hot(y_support, num_classes, dtype=attention.dtype)
     return attention @ onehot
@@ -333,17 +345,44 @@ class MatchingNetsLearner(CheckpointableLearner):
             "support_labels": y_support,
         }
 
+    def serve_adapt_masked(
+        self, istate: InferenceState, x_support, y_support, support_mask
+    ):
+        """Geometry-aware twin of ``serve_adapt`` (serve/geometry.py): the
+        mask rides INSIDE the artifact — attention happens at classify
+        time, so that is where padded support rows must drop out (see
+        ``cosine_attention_predictions``)."""
+        if self.parity_bug:
+            raise NotImplementedError(
+                "episode-geometry coarsening is undefined under parity_bug "
+                "(the reference head only conforms when S == T == classes)"
+            )
+        adapted = self.serve_adapt(istate, x_support, y_support)
+        adapted["support_mask"] = support_mask.astype(jnp.float32)
+        return adapted
+
     def serve_classify(self, istate: InferenceState, adapted, x_query):
         """ONE task's attention classify against the cached support
         embeddings. Returns class probabilities — the same per-task ``preds``
         ``run_validation_iter`` reports (BN stats never affect outputs, so
         embedding queries with the template state matches the eval graph's
-        support-evolved state bit-for-bit)."""
+        support-evolved state bit-for-bit). An artifact produced by
+        ``serve_adapt_masked`` carries its support mask (a static pytree
+        key — both artifact layouts trace to their own program)."""
         x_query = decode_images(x_query, self.cfg.wire_codec, self.cfg.dtype)
         target_emb, _ = self.backbone.apply(
             cast_floats(istate.theta, self.cfg.dtype), istate.bn_state,
             x_query, 0,
         )
+        support_mask = adapted.get("support_mask")
+        if support_mask is not None:
+            return cosine_attention_predictions(
+                adapted["support_emb"],
+                target_emb.astype(jnp.float32),
+                adapted["support_labels"],
+                self.cfg.backbone.num_classes,
+                support_mask,
+            ).astype(jnp.float32)
         return self._predictions(
             adapted["support_emb"],
             target_emb.astype(jnp.float32),

@@ -1,0 +1,373 @@
+"""Prototypical networks (Snell et al., "Prototypical Networks for
+Few-shot Learning").
+
+Each class is represented by the MEAN of its support embeddings (the
+prototype); a query is classified by negative squared Euclidean distance
+to every prototype. There is no inner loop at all — "adaptation" is one
+forward pass over the support set plus a per-class mean — which makes this
+the natural high-QPS serving tier: the cacheable adapted artifact is a
+single ``(num_classes, feat)`` prototype matrix, and a cache hit pays only
+the query forward.
+
+Follows the matching-nets module's conventions where they overlap
+(``models/matching_nets.py``): embeddings come from the FULL backbone
+including the linear head (the repo's established embedding surface, so
+both metric learners share one backbone contract), the distance/softmax
+head math runs in f32 regardless of the compute dtype, and the divergence
+sentinel covers every task's loss plus the update's grad norm.
+
+Training is episodic meta-training proper (unlike matching-nets'
+reference-parity sequential per-task Adam): the per-task prototype loss is
+``jax.vmap``'d over the meta-batch and ONE Adam update applies to the task
+mean — prototypical networks' published training procedure, and the same
+task-axis treatment as MAML's outer step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops import accuracy, cross_entropy
+from .backbone import build_backbone
+from .common import (
+    CheckpointableLearner,
+    InferenceState,
+    StagedBatch,
+    cast_floats,
+    cosine_epoch_lr,
+    decode_images,
+    decode_train_batch,
+    guard_nonfinite_update,
+    make_injected_adam,
+    named_partial,
+    nonfinite_flag,
+    prepare_batch,
+    set_injected_lr,
+)
+from .maml import MAMLConfig
+
+Tree = Any
+
+__all__ = [
+    "ProtoNetsConfig",
+    "ProtoNetsLearner",
+    "ProtoNetsState",
+    "class_prototypes",
+    "squared_distance_logits",
+    "prototype_logits",
+]
+
+#: ProtoNets reuses the shared trainer config surface; inner-loop fields
+#: (task LR, step counts, MSL) are simply inert — there is no inner loop.
+ProtoNetsConfig = MAMLConfig
+
+
+class ProtoNetsState(NamedTuple):
+    theta: Tree
+    bn_state: Tree
+    opt_state: Tree
+    iteration: jax.Array
+
+
+def class_prototypes(
+    support_emb: jax.Array,
+    y_support: jax.Array,
+    num_classes: int,
+    support_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Per-class mean support embeddings, ``(num_classes, feat)``.
+
+    Computed with a one-hot contraction so the shape is static in
+    ``num_classes`` regardless of the episode's way — absent classes get
+    a zero prototype (count clamped to 1), never a NaN. ``support_mask``
+    (episode-geometry contract, serve/geometry.py) zeroes padded support
+    rows out of the one-hot weights, so a padded row's embedding
+    contributes an EXACT zero to every prototype and the real-class
+    prototypes match an unpadded dispatch bit-for-bit on a
+    row-independent backbone. The SINGLE prototype implementation: the
+    eval graph and ``serve_adapt``(+``_masked``) all route through it,
+    which is what keeps serve parity a structural property.
+    """
+    onehot = jax.nn.one_hot(y_support, num_classes, dtype=support_emb.dtype)
+    if support_mask is not None:
+        onehot = onehot * support_mask.astype(onehot.dtype)[:, None]
+    counts = jnp.sum(onehot, axis=0)
+    return (onehot.T @ support_emb) / jnp.maximum(counts, 1.0)[:, None]
+
+
+def squared_distance_logits(
+    query_emb: jax.Array, prototypes: jax.Array
+) -> jax.Array:
+    """``-||query - prototype||^2`` logits, ``(T, num_classes)`` — the
+    classify half, shared by the eval graph and ``serve_classify``."""
+    d2 = jnp.sum(
+        (query_emb[:, None, :] - prototypes[None, :, :]) ** 2, axis=-1
+    )
+    return -d2
+
+
+def prototype_logits(
+    support_emb: jax.Array,
+    y_support: jax.Array,
+    query_emb: jax.Array,
+    num_classes: int,
+    support_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Full episode head: prototypes then distance logits."""
+    protos = class_prototypes(
+        support_emb, y_support, num_classes, support_mask
+    )
+    return squared_distance_logits(query_emb, protos)
+
+
+class ProtoNetsLearner(CheckpointableLearner):
+    """Reference trainer contract: ``run_train_iter`` / ``run_validation_iter``."""
+
+    def __init__(self, cfg: MAMLConfig, mesh=None):
+        self.cfg = cfg
+        self.backbone = build_backbone(cfg.backbone)
+        self.current_epoch = 0
+        self.mesh = mesh
+        self.tx = make_injected_adam(cfg.meta_learning_rate, cfg.clip_grad_value)
+
+        # Mesh runs: explicit REPLICATED in/out shardings, matching the
+        # matching-nets baseline's layout policy — the vmapped task loss
+        # is cheap enough that replication keeps staged batches and
+        # checkpoint re-sharding consistent with the other learners
+        # without a dp split. Eval keeps NO donation: the caller returns
+        # the same state object it passed in.
+        jit_kwargs: dict = {}
+        if mesh is not None:
+            from ..parallel.mesh import replicated
+
+            rep = replicated(mesh)
+            jit_kwargs = dict(
+                in_shardings=(rep, rep), out_shardings=(rep, rep, rep)
+            )
+        self._mesh_jit_kwargs = jit_kwargs
+
+        self._train_step = jax.jit(
+            named_partial("protonets_train_step", self._run_batch, training=True),
+            donate_argnums=(0,),
+            **jit_kwargs,
+        )
+        self._eval_step = jax.jit(
+            named_partial("protonets_eval_step", self._run_batch, training=False),
+            **jit_kwargs,
+        )
+
+    def staged_batch_sharding(self, group: int = 1):
+        """Stager contract (see maml.staged_batch_sharding): batches ride
+        replicated on mesh runs, like the matching-nets baseline."""
+        del group
+        if self.mesh is None:
+            return None
+        from ..parallel.mesh import replicated
+
+        return replicated(self.mesh)
+
+    def init_state(self, key: jax.Array) -> ProtoNetsState:
+        theta, bn_state = self.backbone.init(key)
+        return ProtoNetsState(
+            theta=theta,
+            bn_state=bn_state,
+            opt_state=self.tx.init(theta),
+            iteration=jnp.zeros((), jnp.int32),
+        )
+
+    def _epoch_lr(self, epoch: int) -> float:
+        cfg = self.cfg
+        return cosine_epoch_lr(
+            epoch, cfg.meta_learning_rate, cfg.min_learning_rate, cfg.total_epochs
+        )
+
+    def _task_loss(self, theta, bn, xs, ys, xt, yt):
+        # Boundary cast of the f32 masters to the compute dtype (identity
+        # at f32): the embedding forwards carry the bf16 win; prototype
+        # means, distances and the softmax NLL stay f32 (tiny,
+        # precision-sensitive head math — same policy as matching_nets).
+        theta = cast_floats(theta, self.cfg.dtype)
+        support_emb, bn1 = self.backbone.apply(theta, bn, xs, 0)
+        target_emb, bn2 = self.backbone.apply(theta, bn1, xt, 0)
+        logits = prototype_logits(
+            support_emb.astype(jnp.float32),
+            ys,
+            target_emb.astype(jnp.float32),
+            self.cfg.backbone.num_classes,
+        )
+        loss = cross_entropy(logits, yt)
+        acc = accuracy(logits, yt)
+        return loss, (acc, logits, bn2)
+
+    def _run_batch(self, state: ProtoNetsState, batch, *, training: bool):
+        # uint8 wire decode (cast / descale / normalize, plus the on-device
+        # train augmentation when the batch carries an aug operand) — see
+        # WireCodec / DeviceAugment in models/common.
+        xs_b, xt_b, ys_b, yt_b = decode_train_batch(
+            batch, self.cfg.wire_codec, self.cfg.dtype,
+            self.cfg.device_augment if training else None,
+        )
+
+        def batch_loss(theta):
+            losses, (accs, preds, bns) = jax.vmap(
+                self._task_loss, in_axes=(None, None, 0, 0, 0, 0)
+            )(theta, state.bn_state, xs_b, ys_b, xt_b, yt_b)
+            # Mean over tasks — ONE meta-update per episode batch (the
+            # published ProtoNets procedure; contrast matching_nets'
+            # reference-parity per-task sequential Adam).
+            return jnp.mean(losses), (losses, accs, preds, bns)
+
+        if training:
+            grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
+            (loss, (losses, accs, preds, bns)), grads = grad_fn(state.theta)
+            updates, opt_state = self.tx.update(
+                grads, state.opt_state, state.theta
+            )
+            theta = optax.apply_updates(state.theta, updates)
+            grad_norm = optax.global_norm(grads)
+            # Running stats evolved per task in parallel, mean-reduced
+            # across tasks (diagnostic state — see ops/norm.py).
+            bn_state = jax.tree.map(lambda s: jnp.mean(s, axis=0), bns)
+            new_state = ProtoNetsState(
+                theta, bn_state, opt_state, state.iteration + 1
+            )
+            # Divergence sentinel over every task's loss AND the update
+            # grad norm: a finite mean with one inf task — or an inf grad
+            # under a finite loss — must not poison theta.
+            nonfinite = nonfinite_flag(losses, grad_norm)
+            new_state = guard_nonfinite_update(
+                self.cfg.skip_nonfinite_updates, nonfinite, new_state, state
+            )
+        else:
+            loss, (losses, accs, preds, _bns) = batch_loss(state.theta)
+            nonfinite = nonfinite_flag(losses)
+            new_state = state  # pure eval: running stats discarded
+        metrics = dict(
+            loss=loss, accuracy=jnp.mean(accs), nonfinite=nonfinite
+        )
+        return new_state, metrics, preds
+
+    # -- trainer contract ------------------------------------------------
+
+    def run_train_iter(self, state: ProtoNetsState, data_batch, epoch):
+        epoch = int(epoch)
+        self.current_epoch = epoch
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else prepare_batch(data_batch, codec=self.cfg.wire_codec)
+        )
+        lr = self._epoch_lr(epoch)
+        state = state._replace(opt_state=set_injected_lr(state.opt_state, lr))
+        new_state, metrics, _ = self._train_step(state, batch)
+        # Device scalars: callers float() them only when read (lazy metrics
+        # keep the dispatch pipeline full — see maml.run_train_iter).
+        losses = {
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
+            "nonfinite": metrics["nonfinite"],
+            "learning_rate": lr,
+        }
+        return new_state, losses
+
+    def run_validation_iter(self, state: ProtoNetsState, data_batch):
+        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
+        _, metrics, preds = self._eval_step(state, batch)
+        losses = {
+            "loss": metrics["loss"],
+            "accuracy": metrics["accuracy"],
+        }
+        return state, losses, preds
+
+    # -- program-ledger declarations (telemetry/device.py) ---------------
+
+    def ledger_train_program(
+        self, state: ProtoNetsState, data_batch, epoch, single: bool = True
+    ):
+        """``(name, lowered, K)`` of the dispatched train program — the
+        ledger's FLOPs/HBM accounting hook (same contract as
+        maml.ledger_train_program; no K-scan form here, so K is 1)."""
+        del epoch, single
+        batch = (
+            tuple(data_batch.arrays)
+            if isinstance(data_batch, StagedBatch)
+            else prepare_batch(data_batch, codec=self.cfg.wire_codec)
+        )
+        return (
+            "protonets_train_step",
+            self._train_step.lower(state, batch),
+            1,
+        )
+
+    def ledger_eval_program(self, state: ProtoNetsState, data_batch):
+        """``(name, lowered, K)`` of the eval program (always K=1)."""
+        batch = prepare_batch(data_batch, codec=self.cfg.wire_codec)
+        return (
+            "protonets_eval_step",
+            self._eval_step.lower(state, batch),
+            1,
+        )
+
+    # ------------------------------------------------------------------
+    # Serving contract (serve/engine.py)
+    # ------------------------------------------------------------------
+    #
+    # "Adapt" is one support forward + a per-class mean: the cacheable
+    # artifact is the (num_classes, feat) prototype matrix — the smallest
+    # adapted artifact of any family, and the reason this learner is the
+    # high-QPS serving tier (a cache hit pays a single query forward).
+
+    def init_inference_state(self, key: jax.Array) -> InferenceState:
+        """Params + BN template for ``load_for_inference`` — no optimizer."""
+        theta, bn_state = self.backbone.init(key)
+        return InferenceState(theta=theta, bn_state=bn_state)
+
+    def inference_state(self, state) -> InferenceState:
+        if isinstance(state, InferenceState):
+            return state
+        return InferenceState(theta=state.theta, bn_state=state.bn_state)
+
+    def _embed(self, istate: InferenceState, images):
+        images = decode_images(images, self.cfg.wire_codec, self.cfg.dtype)
+        emb, _ = self.backbone.apply(
+            cast_floats(istate.theta, self.cfg.dtype), istate.bn_state,
+            images, 0,
+        )
+        return emb.astype(jnp.float32)
+
+    def serve_adapt(self, istate: InferenceState, x_support, y_support):
+        """ONE task's prototype matrix — the adaptation-free 'adapt'."""
+        emb = self._embed(istate, x_support)
+        return {
+            "prototypes": class_prototypes(
+                emb, y_support, self.cfg.backbone.num_classes
+            )
+        }
+
+    def serve_adapt_masked(
+        self, istate: InferenceState, x_support, y_support, support_mask
+    ):
+        """Geometry-aware twin of ``serve_adapt`` (serve/geometry.py):
+        padded support rows carry ``support_mask == 0`` and contribute an
+        exact zero to every prototype."""
+        emb = self._embed(istate, x_support)
+        return {
+            "prototypes": class_prototypes(
+                emb, y_support, self.cfg.backbone.num_classes, support_mask
+            )
+        }
+
+    def serve_classify(self, istate: InferenceState, adapted, x_query):
+        """ONE task's distance classify against the cached prototypes.
+        Returns the same ``-||q - proto||^2`` logits the eval graph's
+        per-task preds report (BN always normalizes with batch statistics
+        — ops/norm.py — so embedding queries with the template state
+        matches the eval graph's support-evolved state bit-for-bit)."""
+        query_emb = self._embed(istate, x_query)
+        return squared_distance_logits(
+            query_emb, adapted["prototypes"]
+        ).astype(jnp.float32)

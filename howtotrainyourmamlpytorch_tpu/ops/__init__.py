@@ -9,7 +9,7 @@ from .conv import conv2d
 from .linear import linear
 from .norm import batch_norm, layer_norm, BatchNormState
 from .pool import max_pool2d, avg_pool2d
-from .losses import cross_entropy, accuracy
+from .losses import cross_entropy, masked_cross_entropy, accuracy
 from .initializers import xavier_uniform
 from .layout import lane_padded_width, zero_pad_to
 
@@ -22,6 +22,7 @@ __all__ = [
     "max_pool2d",
     "avg_pool2d",
     "cross_entropy",
+    "masked_cross_entropy",
     "accuracy",
     "xavier_uniform",
     "lane_padded_width",

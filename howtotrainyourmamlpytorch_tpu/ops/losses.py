@@ -17,6 +17,25 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean(nll)
 
 
+def masked_cross_entropy(
+    logits: jax.Array, labels: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Cross-entropy averaged over the rows where ``mask`` is 1.
+
+    The episode-geometry contract (serve/geometry.py): padded support rows
+    carry ``mask == 0`` and must contribute EXACTLY zero to both the loss
+    value and its gradient. ``row * 0.0`` is an exact zero and the
+    normalizer is the REAL row count, so with an all-ones mask this
+    reproduces :func:`cross_entropy`'s ``sum/n`` bit-for-bit (``jnp.mean``
+    lowers to the same sum-then-divide) — the identity the
+    padded-vs-unpadded parity tests in tests/test_geometry.py pin.
+    """
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None].astype(jnp.int32), axis=-1)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll[..., 0] * mask) / jnp.sum(mask)
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean argmax accuracy (reference ``few_shot_learning_system.py:247-249``)."""
     preds = jnp.argmax(logits, axis=-1)

@@ -198,6 +198,14 @@ DP_STATE_RULES = (
 #:   tiny, features are wide; XLA inserts the psum over partial products);
 #: * LSLR tables and BN running stats replicated (small, and the per-task
 #:   fast weights ride mp-replicated anyway — ``mesh.mp_grad_anchor``).
+#:
+#: Coverage is closed over EVERY learner family's state tree — MAML and
+#: ANIL (``TrainState``: ANIL's LSLR holds head leaves only, matched by
+#: the same ``lslr/`` rule), the gradient-descent and matching-nets
+#: baselines, and protonets (``ProtoNetsState``: theta/bn/opt/iteration,
+#: no LSLR) — enforced mechanically by graftlint's ``spec-coverage`` rule
+#: (tools/graftlint/programs.py), which refuses both uncovered leaves and
+#: dead rules whenever a family is added.
 MP_STATE_RULES = (
     (r"(^|/)lslr/", P()),
     (r"(^|/)bn_state(/|$)", P()),

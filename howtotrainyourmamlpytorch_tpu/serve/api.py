@@ -51,6 +51,7 @@ from ..utils import faultinject
 from .batcher import MicroBatcher
 from .engine import ServeConfig, ServingEngine
 from .errors import DeadlineExceededError, OverloadedError, SwapRejectedError
+from .geometry import GeometryRejectedError
 from .metrics import ServeMetrics
 from .resilience.admission import AdmissionController
 from .resilience.swap import promote_checkpoint, promote_state
@@ -140,6 +141,11 @@ class ServingAPI:
             "predictions": np.argmax(logits, axis=-1),
             "cache_hit": cache_hit,
             "bucket": "x".join(str(d) for d in episode.bucket),
+            # True when geometry coarsening padded this episode up to its
+            # bucket (the logits are already sliced/masked back to the
+            # REAL geometry, so clients need no special handling — the
+            # flag is observability).
+            "coarsened": episode.coarsened,
             "state_version": self.engine.state_version,
         }
 
@@ -337,6 +343,16 @@ class _Handler(BaseHTTPRequestHandler):
                 {"Retry-After": f"{exc.retry_after_s:g}"},
             )
             return
+        except GeometryRejectedError as exc:
+            # An unservable episode SHAPE — a client error with an
+            # actionable message (the error names the declared lattice),
+            # deliberately distinct from overload: no Retry-After, no
+            # shed flag, because retrying the identical episode can
+            # never succeed.
+            self._send_json(
+                400, {"error": str(exc), "geometry_rejected": True}
+            )
+            return
         except (KeyError, ValueError, TypeError) as exc:
             self._send_json(400, {"error": str(exc)})
             return
@@ -353,6 +369,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "predictions": np.asarray(result["predictions"]).tolist(),
                 "cache_hit": bool(result["cache_hit"]),
                 "bucket": result["bucket"],
+                "coarsened": bool(result["coarsened"]),
                 "state_version": result["state_version"],
             },
         )

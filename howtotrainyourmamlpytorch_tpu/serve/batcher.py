@@ -4,10 +4,13 @@ The throughput lever of the serving runtime: the learners were TRAINED
 with a vmapped meta-batch axis, so the device program is already shaped to
 answer B episodes for barely more than the cost of one — the batcher's job
 is to refill that axis from CONCURRENT traffic. Each incoming episode
-joins the pending group for its shape bucket; a group flushes when it
-reaches ``max_batch`` episodes (the engine's fixed meta-batch), when its
-oldest request has waited ``max_wait_ms``, or when the tightest member
-DEADLINE would otherwise expire in the queue — the classic
+joins the pending group for its shape bucket — under an episode-geometry
+lattice (``serve/geometry.py``) that bucket is the COARSENED one, so
+heterogeneous (way, shot, query) traffic co-batches into the small
+declared bucket set instead of fragmenting into singleton groups; a group
+flushes when it reaches ``max_batch`` episodes (the engine's fixed
+meta-batch), when its oldest request has waited ``max_wait_ms``, or when
+the tightest member DEADLINE would otherwise expire in the queue — the classic
 latency-vs-throughput dial (0 ms degenerates to per-request dispatch,
 large values trade tail latency for device efficiency).
 

@@ -44,8 +44,16 @@ def support_digest(
     *,
     learner: str,
     state_version: int,
+    mask: np.ndarray | None = None,
 ) -> str:
-    """Content hash of one episode's support set under one served model."""
+    """Content hash of one episode's support set under one served model.
+
+    ``mask`` is the geometry support mask (``serve/geometry.py``) when the
+    episode was coarsened: the adapted artifact is a function of the mask
+    too, and hashing it keeps a padded episode from ever colliding with a
+    genuine episode whose tail rows happen to be zero images labeled 0.
+    ``None`` (no geometry) hashes exactly the pre-geometry bytes, so
+    digests from maskless deployments are unchanged."""
     h = hashlib.sha256()
     h.update(f"{learner}|v{state_version}|".encode())
     x = np.ascontiguousarray(x_support)
@@ -54,6 +62,10 @@ def support_digest(
     h.update(x.tobytes())
     h.update(str(y.dtype).encode() + b"|" + str(y.shape).encode() + b"|")
     h.update(y.tobytes())
+    if mask is not None:
+        m = np.ascontiguousarray(mask)
+        h.update(b"mask|" + str(m.shape).encode() + b"|")
+        h.update(m.tobytes())
     return h.hexdigest()
 
 

@@ -48,6 +48,7 @@ from ..telemetry.device import ProgramLedger
 from ..utils import faultinject
 from .cache import AdaptedParamsCache, support_digest
 from .errors import SwapRejectedError
+from .geometry import GeometryPolicy, GeometryRejectedError
 from .metrics import ServeMetrics
 from .tier import ArtifactSpill, ExecutableCache
 
@@ -93,8 +94,10 @@ class _Published(NamedTuple):
 #: cache digests, and metric labels.
 _LEARNER_FAMILIES = {
     "MAMLFewShotLearner": "maml",
+    "ANILLearner": "anil",
     "GradientDescentLearner": "gradient_descent",
     "MatchingNetsLearner": "matching_nets",
+    "ProtoNetsLearner": "protonets",
 }
 
 
@@ -135,6 +138,16 @@ class ServeConfig:
     #: Disk-spill retention, in entries; oldest entries (mtime) are
     #: pruned past this. <= 0 disables pruning.
     spill_max_entries: int = 4096
+    #: Declared episode-geometry bucket lattice (``serve/geometry.py``):
+    #: a tuple of ``(way, shot, query)`` triples. When set, every incoming
+    #: episode is coarsened UP to its smallest containing entry with
+    #: structurally-zero padding + a support mask, so a mixed-geometry
+    #: request stream compiles AT MOST one program pair per lattice entry;
+    #: episodes no entry contains are rejected 400 at the front door.
+    #: Requires a row-independent backbone (``norm_layer="layer_norm"``) —
+    #: engine construction refuses the lattice otherwise. ``None``
+    #: disables coarsening (today's exact-bucket behavior).
+    geometry_lattice: tuple | None = None
 
     def __post_init__(self):
         if self.meta_batch_size < 1:
@@ -173,10 +186,28 @@ class EpisodeRequest:
     #: which is what lets ``tools/episode_miner.py`` turn low-margin
     #: serving episodes back into trainable replay seeds.
     tag: str | None = None
+    #: Geometry coarsening (serve/geometry.py), set only when the engine
+    #: has a lattice: ``support_mask`` (1.0 real prefix / 0.0 padding)
+    #: rides the wire into the masked adapt program, and the ``real_*``
+    #: geometry drives the response slice (query rows past ``real_query``
+    #: are dropped, logit columns past ``real_way`` are ``-inf``-masked).
+    #: ``way``/``shot`` above then hold the COARSENED values, so bucket
+    #: grouping, batching and pool routing see only lattice entries.
+    support_mask: np.ndarray | None = None
+    real_way: int | None = None
+    real_shot: int | None = None
+    real_query: int | None = None
 
     @property
     def bucket(self) -> tuple[int, int, int]:
         return (self.way, self.shot, int(self.x_query.shape[0]))
+
+    @property
+    def coarsened(self) -> bool:
+        """True when geometry padding actually grew this episode."""
+        return self.real_way is not None and (
+            (self.real_way, self.real_shot, self.real_query) != self.bucket
+        )
 
     def expired(self, now: float | None = None) -> bool:
         if self.deadline is None:
@@ -200,6 +231,15 @@ class ServingEngine:
         self.family = _LEARNER_FAMILIES.get(
             type(learner).__name__, type(learner).__name__.lower()
         )
+        # Episode-geometry coarsening (serve/geometry.py): policy
+        # attachment validates the bit-exactness precondition (a
+        # row-independent backbone) and the head width up front —
+        # a lattice the model cannot serve must fail at construction,
+        # not on the first coarsened dispatch.
+        self.geometry: GeometryPolicy | None = None
+        if self.config.geometry_lattice:
+            self.geometry = GeometryPolicy(self.config.geometry_lattice)
+            self.geometry.validate_backbone(learner.cfg.backbone)
         self.cache = AdaptedParamsCache(self.config.cache_capacity)
         self._published = _Published(0, learner.inference_state(state))
         self._compiles: dict[str, int] = {}
@@ -286,15 +326,33 @@ class ServingEngine:
     def _build_programs(self):
         learner = self.learner
         note = self._note_trace
-        adapt_vm = jax.vmap(learner.serve_adapt, in_axes=(None, 0, 0))
         classify_vm = jax.vmap(learner.serve_classify, in_axes=(None, 0, 0))
-
-        def adapt_batched(istate, x_support, y_support):
-            note(
-                "adapt:"
-                + "x".join(str(d) for d in x_support.shape[:2])
+        if self.geometry is not None:
+            # Geometry mode: ONE masked program pair per bucket — every
+            # episode (exact fits included, with an all-ones mask) rides
+            # the masked adapt, so coarsening never doubles the program
+            # set. The mask folds in as exact zeros, keeping all-ones
+            # dispatches bit-identical to the unmasked program's output.
+            adapt_mask_vm = jax.vmap(
+                learner.serve_adapt_masked, in_axes=(None, 0, 0, 0)
             )
-            return adapt_vm(istate, x_support, y_support)
+
+            def adapt_batched(istate, x_support, y_support, support_mask):
+                note(
+                    "adapt:"
+                    + "x".join(str(d) for d in x_support.shape[:2])
+                )
+                return adapt_mask_vm(istate, x_support, y_support, support_mask)
+
+        else:
+            adapt_vm = jax.vmap(learner.serve_adapt, in_axes=(None, 0, 0))
+
+            def adapt_batched(istate, x_support, y_support):
+                note(
+                    "adapt:"
+                    + "x".join(str(d) for d in x_support.shape[:2])
+                )
+                return adapt_vm(istate, x_support, y_support)
 
         def classify_batched(istate, adapted, x_query):
             note(
@@ -330,12 +388,21 @@ class ServingEngine:
             for leaf in leaves
         )
 
-    def _run_adapt(self, istate, xs, ys):
+    def _adapt_args(self, istate, xs, ys, mask=None):
+        """The adapt program's full positional arg tuple — with a geometry
+        policy the program is the masked variant and the mask is a real
+        argument (never None); without one it takes no mask."""
+        if self.geometry is not None:
+            return (istate, xs, ys, mask)
+        return (istate, xs, ys)
+
+    def _run_adapt(self, istate, xs, ys, mask=None):
+        args = self._adapt_args(istate, xs, ys, mask)
         if self._aot:
-            loaded = self._aot.get(self._signature("adapt", istate, xs, ys))
+            loaded = self._aot.get(self._signature("adapt", *args))
             if loaded is not None:
-                return loaded(istate, xs, ys)
-        return self._adapt(istate, xs, ys)
+                return loaded(*args)
+        return self._adapt(*args)
 
     def _run_classify(self, istate, stacked, xq):
         if self._aot:
@@ -440,6 +507,7 @@ class ServingEngine:
 
     def _ledger_record(
         self, bucket, istate, xs=None, ys=None, stacked=None, xq=None,
+        mask=None,
     ) -> None:
         """Best-effort ledger ingest of this bucket's program pair. Labels
         match the compile table's (``adapt:BxS`` / ``classify:BxT``), so
@@ -452,7 +520,8 @@ class ServingEngine:
         bucket_label = "x".join(str(d) for d in bucket)
         try:
             if xs is not None:
-                sig = self._signature("adapt", istate, xs, ys)
+                adapt_args = self._adapt_args(istate, xs, ys, mask)
+                sig = self._signature("adapt", *adapt_args)
                 # Signatures served from the durable AOT cache skip BOTH
                 # paths below: in a fresh process ``lower().compile()``
                 # would be a REAL backend compile (the in-process jit
@@ -463,13 +532,13 @@ class ServingEngine:
                     label = "adapt:" + "x".join(str(d) for d in xs.shape[:2])
                     lowered = None
                     if not self.ledger.has_entry(label):
-                        lowered = self._adapt.lower(istate, xs, ys)
+                        lowered = self._adapt.lower(*adapt_args)
                         self.ledger.record_lowered(
                             label, lowered,
                             k=1, role="serve_adapt", bucket=bucket_label,
                         )
                     self._persist_exec(
-                        "adapt", sig, (istate, xs, ys), lowered
+                        "adapt", sig, adapt_args, lowered
                     )
             if xq is not None and stacked is not None:
                 sig = self._signature("classify", istate, stacked, xq)
@@ -553,17 +622,42 @@ class ServingEngine:
                 f"shot count); got per-class counts {counts.tolist()}"
             )
         shot = int(counts[0])
+        support_mask = None
+        real_way = real_shot = real_query = None
+        if self.geometry is not None:
+            # Coarsen onto the lattice BEFORE wire encoding/digesting:
+            # the padded arrays are the wire truth (digest, cache key,
+            # pool routing all see the coarsened episode, so the fleet
+            # agrees on its identity). Rejections are client errors
+            # (ValueError -> 400) counted separately from overload.
+            try:
+                padded = self.geometry.pad_episode(
+                    xs, ys, xq, way=way, shot=shot
+                )
+            except GeometryRejectedError:
+                self.metrics.geometry_rejected_total.inc()
+                raise
+            if padded.coarsened:
+                self.metrics.geometry_coarsened_total.inc()
+            xs, ys, xq = padded.x_support, padded.y_support, padded.x_query
+            support_mask = padded.support_mask
+            way, shot = padded.way, padded.shot
+            real_way, real_shot = padded.real_way, padded.real_shot
+            real_query = padded.real_query
         codec = self.learner.cfg.wire_codec
         if codec is not None:
             xs, xq = encode_images(xs, codec), encode_images(xq, codec)
         digest = support_digest(
-            xs, ys, learner=self.family, state_version=self.state_version
+            xs, ys, learner=self.family, state_version=self.state_version,
+            mask=support_mask,
         )
         if tag is not None:
             tag = str(tag)[:MAX_TAG_LEN]
         return EpisodeRequest(
             x_support=xs, y_support=ys, x_query=xq,
             way=way, shot=shot, digest=digest, tag=tag,
+            support_mask=support_mask,
+            real_way=real_way, real_shot=real_shot, real_query=real_query,
         )
 
     # ------------------------------------------------------------------
@@ -611,6 +705,7 @@ class ServingEngine:
         # --- adapt (cache misses only) ---------------------------------
         adapt_ms: float | None = None
         xs = ys = None  # adapt inputs, kept for the ledger's AOT ingest
+        mask = None
         artifacts: list[Tree | None] = [None] * len(eps)
         miss: list[int] = []
         for i, ep in enumerate(eps):
@@ -624,8 +719,10 @@ class ServingEngine:
         if miss:
             xs = self._pad_rows([eps[i].x_support for i in miss])
             ys = self._pad_rows([eps[i].y_support for i in miss])
+            if self.geometry is not None:
+                mask = self._pad_rows([eps[i].support_mask for i in miss])
             t0 = time.perf_counter()
-            adapted = self._run_adapt(istate, xs, ys)
+            adapted = self._run_adapt(istate, xs, ys, mask)
             adapted = jax.block_until_ready(adapted)
             adapt_ms = (time.perf_counter() - t0) * 1e3
             self.metrics.adapt_latency.observe(adapt_ms)
@@ -651,6 +748,7 @@ class ServingEngine:
         self._note_bucket(eps[0].bucket)
         self._ledger_record(
             eps[0].bucket, istate, xs=xs, ys=ys, stacked=stacked, xq=xq,
+            mask=mask,
         )
         self.ready = True
         # Per-episode confidence + nonfinite accounting: pure numpy over
@@ -659,14 +757,31 @@ class ServingEngine:
         # entropies/tags feed tools/episode_miner.py's hard-episode
         # feedback loop; the nonfinite counter is the /metrics signal the
         # promotion daemon's post-publish SLO watch rolls back on.
+        #
+        # Geometry postprocess per episode: padded query rows are sliced
+        # off and logit columns past the REAL way are -inf-masked (a
+        # padded class slot must never win an argmax). Confidence and
+        # nonfinite stats are computed on the REAL slice BEFORE the -inf
+        # fill — the sentinel watches the model's numerics, and the
+        # structural -inf columns would trip it on every coarsened
+        # episode.
         margins, entropies, nonfinite = [], [], 0
-        for i in range(len(eps)):
+        results: list[np.ndarray] = []
+        for i, ep in enumerate(eps):
             row = host[i]
-            if not np.isfinite(row).all():
+            if ep.real_query is not None and ep.real_query < row.shape[0]:
+                row = row[: ep.real_query]
+            real = row
+            if ep.real_way is not None and ep.real_way < row.shape[1]:
+                real = row[:, : ep.real_way]
+                row = row.copy()
+                row[:, ep.real_way :] = -np.inf
+            if not np.isfinite(real).all():
                 nonfinite += 1
-            margin, entropy = confidence_stats(row)
+            margin, entropy = confidence_stats(real)
             margins.append(margin)
             entropies.append(entropy)
+            results.append(row)
         if nonfinite:
             self.metrics.nonfinite_logits_total.inc(nonfinite)
         with self._compiles_lock:
@@ -676,7 +791,9 @@ class ServingEngine:
             "serve_dispatch",
             dispatch_id=dispatch_id,
             bucket="x".join(str(d) for d in eps[0].bucket),
+            family=self.family,
             episodes=len(eps),
+            coarsened=sum(1 for ep in eps if ep.coarsened),
             cache_hits=len(eps) - len(miss),
             adapt_ms=adapt_ms,
             classify_ms=classify_ms,
@@ -686,7 +803,7 @@ class ServingEngine:
             tags=[ep.tag for ep in eps],
             nonfinite=nonfinite,
         )
-        return [host[i] for i in range(len(eps))]
+        return results
 
     # ------------------------------------------------------------------
     # Warmup
@@ -709,7 +826,9 @@ class ServingEngine:
         xq = xq.reshape((query,) + img).astype(np.float32)
         return self.prepare_episode(xs, ys, xq)
 
-    def warmup(self, buckets: Sequence[tuple[int, int, int]]) -> None:
+    def warmup(
+        self, buckets: Sequence[tuple[int, int, int]] | None = None
+    ) -> None:
         """Pre-compiles the program pair for each declared ``(way, shot,
         query)`` bucket so first-request latency is a dispatch, not an XLA
         compile, and marks the engine ready. Bypasses the cache (synthetic
@@ -722,18 +841,33 @@ class ServingEngine:
         in ``tests/test_serve_tier.py``); a miss compiles via the jit
         wrapper and persists the executable for the next respawn (in
         ``_ledger_record``'s AOT ingest, an in-process cache hit)."""
+        if buckets is None:
+            if self.geometry is None:
+                raise ValueError(
+                    "warmup() needs explicit buckets without a geometry "
+                    "lattice (with one, the lattice IS the warm set)"
+                )
+            # A geometry engine's whole program set is the lattice — warm
+            # all of it, so steady state is zero compiles regardless of
+            # which geometries traffic actually mixes.
+            buckets = list(self.geometry.lattice)
         istate = self._published.istate
         for way, shot, query in buckets:
             ep = self._synthetic_episode(way, shot, query)
             xs_b = self._pad_rows([ep.x_support])
             ys_b = self._pad_rows([ep.y_support])
-            adapted = self._warm_one("adapt", istate, xs_b, ys_b)
+            mask_parts = ()
+            mask_b = None
+            if self.geometry is not None:
+                mask_b = self._pad_rows([ep.support_mask])
+                mask_parts = (mask_b,)
+            adapted = self._warm_one("adapt", istate, xs_b, ys_b, *mask_parts)
             xq_b = self._pad_rows([ep.x_query])
             self._warm_one("classify", istate, adapted, xq_b)
             self._note_bucket(ep.bucket)
             self._ledger_record(
                 ep.bucket, istate, xs=xs_b, ys=ys_b,
-                stacked=adapted, xq=xq_b,
+                stacked=adapted, xq=xq_b, mask=mask_b,
             )
         self.ready = True
 
@@ -775,9 +909,13 @@ class ServingEngine:
             ep = self._synthetic_episode(way, shot, query)
             xs_b = self._pad_rows([ep.x_support])
             ys_b = self._pad_rows([ep.y_support])
+            mask_b = (
+                self._pad_rows([ep.support_mask])
+                if self.geometry is not None else None
+            )
             # The _run_* helpers keep canaries compile-free on a warm
             # respawn too (candidate istate shares the published avals).
-            adapted = self._run_adapt(istate, xs_b, ys_b)
+            adapted = self._run_adapt(istate, xs_b, ys_b, mask_b)
             logits = self._run_classify(
                 istate, adapted, self._pad_rows([ep.x_query])
             )

@@ -1,0 +1,214 @@
+"""Episode-geometry coarsening: mixed (way, shot, query) traffic through a
+fixed program set.
+
+The engine's compile contract keys programs by the shape bucket ``(way,
+shot, query)`` — which is exactly right for a fleet serving ONE episode
+geometry, and exactly wrong for heterogeneous clients: every novel
+``(way, shot, query)`` triple mints a new XLA program pair, and an
+adversarial (or merely diverse) request mix compiles without bound.
+
+``GeometryPolicy`` closes that hole declaratively. The operator declares a
+small bucket LATTICE (a handful of ``(way, shot, query)`` triples); every
+incoming episode is coarsened UP to the smallest lattice entry that
+contains it by padding with structurally-zero slots:
+
+* support grows from ``way * shot`` rows to ``W * S`` rows of zero images
+  with label 0; a float32 ``support_mask`` (1.0 over the real prefix, 0.0
+  over the padding) rides the wire next to the episode;
+* queries grow from ``query`` rows to ``Q`` zero rows — padded query rows
+  are sliced off the response before the client sees them;
+* episodes no lattice entry can contain are REJECTED at the front door
+  (``GeometryRejectedError``, a ``ValueError`` → HTTP 400 with the lattice
+  in the message) — an unservable geometry must be an actionable client
+  error, never an unbounded compile.
+
+The numeric contract is BIT-exactness over the real slice: every learner's
+masked serve path (``serve_adapt_masked``) folds the mask in as exact
+zeros — masked cross-entropy in MAML/ANIL/GD inner loops, ``-inf`` on
+padded attention slots in matching nets, zero-weight one-hot rows in
+prototype means — so logits over the real classes of a padded dispatch
+equal a dispatch at the episode's TRUE geometry bit-for-bit, for all five
+families (``tests/test_geometry.py`` pins it). Padding is never lossy.
+One fine print: for MAML/ANIL/GD/protonets the padded dispatch is also
+bit-identical to the pre-geometry MASKLESS program; matching nets'
+attention softmax fuses differently under XLA once the mask is a runtime
+input, so masked-vs-maskless agree only to ~1 ulp (identical argmax) even
+with an all-ones mask at identical shapes — the bit-exact anchor is the
+masked program at the true geometry, which is what a lattice-less client
+of a geometry deployment would get anyway.
+
+That contract has one structural precondition, validated at policy
+attachment: the backbone forward must be ROW-INDEPENDENT, i.e.
+``norm_layer="layer_norm"``. Batch norm mixes statistics across the
+support/query row axis, so a padded zero row would perturb every real
+row's activations — coarsening under batch statistics is silently wrong,
+so the policy refuses to attach rather than serve approximate logits.
+
+Pure numpy + stdlib: the policy runs at the front door (request
+preparation), owns no device state, and is importable without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "GeometryPolicy",
+    "GeometryRejectedError",
+    "PaddedEpisode",
+]
+
+#: The row-independent norm the bit-exactness contract requires (see
+#: module docstring); ``models/backbone.py`` spells it the same way.
+ROW_INDEPENDENT_NORM = "layer_norm"
+
+
+class GeometryRejectedError(ValueError):
+    """No lattice entry can contain the episode.
+
+    A ``ValueError`` subclass so existing front doors already map it to
+    HTTP 400 (client error) — crucially NOT an overload signal: retrying
+    the identical episode can never succeed, and the message names the
+    lattice so the client can re-shape instead of re-send."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PaddedEpisode:
+    """One episode coarsened onto a lattice entry: padded wire arrays, the
+    mask, and both geometries (coarsened = the shape bucket it rides;
+    real = the slice the client gets back)."""
+
+    x_support: np.ndarray  # (W*S, C, H, W) float32, zero-padded tail
+    y_support: np.ndarray  # (W*S,) int32, label 0 over the padding
+    x_query: np.ndarray  # (Q, C, H, W) float32, zero-padded tail
+    support_mask: np.ndarray  # (W*S,) float32, 1.0 real prefix / 0.0 pad
+    way: int  # coarsened
+    shot: int
+    query: int
+    real_way: int
+    real_shot: int
+    real_query: int
+
+    @property
+    def coarsened(self) -> bool:
+        return (self.way, self.shot, self.query) != (
+            self.real_way, self.real_shot, self.real_query
+        )
+
+
+def _slot_cost(entry: tuple[int, int, int]) -> int:
+    """Total padded slots a bucket dispatches — the waste metric coarsening
+    minimizes when several lattice entries contain an episode."""
+    way, shot, query = entry
+    return way * shot + query
+
+
+class GeometryPolicy:
+    """A declared ``(way, shot, query)`` bucket lattice + the coarsening
+    map onto it. Immutable after construction; thread-safe by virtue of
+    having no mutable state."""
+
+    def __init__(self, lattice: Sequence[Sequence[int]]):
+        entries = []
+        for raw in lattice:
+            entry = tuple(int(d) for d in raw)
+            if len(entry) != 3 or min(entry) < 1:
+                raise ValueError(
+                    "geometry lattice entries must be (way, shot, query) "
+                    f"triples of positive ints, got {raw!r}"
+                )
+            entries.append(entry)
+        if not entries:
+            raise ValueError("geometry lattice must declare at least one bucket")
+        # Sorted by slot cost then lexicographically: ``coarsen`` scans in
+        # order and takes the FIRST containing entry, so ties (equal waste)
+        # resolve deterministically across processes — a fleet must agree
+        # on the bucket an episode rides or digest-affine routing breaks.
+        self.lattice: tuple[tuple[int, int, int], ...] = tuple(
+            sorted(set(entries), key=lambda e: (_slot_cost(e), e))
+        )
+
+    def __repr__(self) -> str:
+        return f"GeometryPolicy({list(self.lattice)!r})"
+
+    def describe(self) -> str:
+        return ", ".join("x".join(str(d) for d in e) for e in self.lattice)
+
+    def validate_backbone(self, backbone_cfg) -> None:
+        """Refuses attachment to a model whose forward is not
+        row-independent (see module docstring) or whose head cannot
+        express the lattice's widest way."""
+        norm = getattr(backbone_cfg, "norm_layer", None)
+        if norm != ROW_INDEPENDENT_NORM:
+            raise ValueError(
+                "episode-geometry coarsening requires a row-independent "
+                f"backbone forward (norm_layer={ROW_INDEPENDENT_NORM!r}); "
+                f"got norm_layer={norm!r}, whose batch statistics would let "
+                "padded zero rows perturb real logits"
+            )
+        max_way = max(e[0] for e in self.lattice)
+        num_classes = int(getattr(backbone_cfg, "num_classes", max_way))
+        if max_way > num_classes:
+            raise ValueError(
+                f"geometry lattice declares way {max_way} but the served "
+                f"head has only {num_classes} classes"
+            )
+
+    def coarsen(self, way: int, shot: int, query: int) -> tuple[int, int, int]:
+        """The smallest (fewest padded slots) lattice entry containing
+        ``(way, shot, query)``, or ``GeometryRejectedError``."""
+        for entry in self.lattice:
+            if entry[0] >= way and entry[1] >= shot and entry[2] >= query:
+                return entry
+        raise GeometryRejectedError(
+            f"no geometry bucket can contain a {way}-way {shot}-shot "
+            f"{query}-query episode; the declared lattice is "
+            f"[{self.describe()}] — re-shape the episode to fit a bucket "
+            "(this is a request-shape error, not overload: retrying the "
+            "same episode cannot succeed)"
+        )
+
+    def pad_episode(
+        self,
+        x_support: np.ndarray,
+        y_support: np.ndarray,
+        x_query: np.ndarray,
+        *,
+        way: int,
+        shot: int,
+    ) -> PaddedEpisode:
+        """Coarsens one validated, FLAT, float32 episode (the engine's
+        ``prepare_episode`` shapes — support ``(way*shot, C, H, W)``,
+        labels ``(way*shot,)``, queries ``(T, C, H, W)``) up to its lattice
+        bucket. Real rows stay a contiguous prefix in their original
+        order; padding is exact zeros (images), label 0 (a valid class —
+        the mask, not the label, is what excludes the row), and mask 0."""
+        real_query = int(x_query.shape[0])
+        target_way, target_shot, target_query = self.coarsen(
+            way, shot, real_query
+        )
+        n_real = int(x_support.shape[0])
+        n_rows = target_way * target_shot
+        xs = np.zeros((n_rows,) + x_support.shape[1:], np.float32)
+        xs[:n_real] = x_support
+        ys = np.zeros((n_rows,), np.int32)
+        ys[:n_real] = y_support
+        mask = np.zeros((n_rows,), np.float32)
+        mask[:n_real] = 1.0
+        xq = np.zeros((target_query,) + x_query.shape[1:], np.float32)
+        xq[:real_query] = x_query
+        return PaddedEpisode(
+            x_support=xs,
+            y_support=ys,
+            x_query=xq,
+            support_mask=mask,
+            way=target_way,
+            shot=target_shot,
+            query=target_query,
+            real_way=int(way),
+            real_shot=int(shot),
+            real_query=real_query,
+        )

@@ -55,6 +55,13 @@ class ServeMetrics:
         # prove the candidate BEFORE publish; this counter watches it
         # under real traffic after).
         self.nonfinite_logits_total = Counter("nonfinite_logits_total")
+        # Episode-geometry coarsening (serve/geometry.py): episodes padded
+        # UP onto a lattice bucket vs episodes no bucket could contain
+        # (rejected 400 at the front door). A climbing rejected count is a
+        # client-fleet shape mismatch, NOT overload — keeping the two
+        # distinguishable on a dashboard is the point of the split.
+        self.geometry_coarsened_total = Counter("geometry_coarsened_total")
+        self.geometry_rejected_total = Counter("geometry_rejected_total")
         self.degraded = Gauge("degraded")
         # bucket key -> {"dispatches": int, "episodes": int}; compile counts
         # live with the engine (it owns the jit boundary) and are merged
@@ -99,6 +106,8 @@ class ServeMetrics:
             "swaps_total": self.swaps_total.value,
             "swap_rejected_total": self.swap_rejected_total.value,
             "nonfinite_logits_total": self.nonfinite_logits_total.value,
+            "geometry_coarsened_total": self.geometry_coarsened_total.value,
+            "geometry_rejected_total": self.geometry_rejected_total.value,
             "degraded": bool(self.degraded.value),
             "queue_depth": queue_depth,
             "cache": {
@@ -145,6 +154,12 @@ class ServeMetrics:
             f"{p}_swap_rejected_total {self.swap_rejected_total.value}",
             f"# TYPE {p}_nonfinite_logits_total counter",
             f"{p}_nonfinite_logits_total {self.nonfinite_logits_total.value}",
+            f"# TYPE {p}_geometry_coarsened_total counter",
+            f"{p}_geometry_coarsened_total "
+            f"{self.geometry_coarsened_total.value}",
+            f"# TYPE {p}_geometry_rejected_total counter",
+            f"{p}_geometry_rejected_total "
+            f"{self.geometry_rejected_total.value}",
             f"# TYPE {p}_degraded gauge",
             f"{p}_degraded {int(self.degraded.value)}",
             f"# TYPE {p}_queue_depth gauge",

@@ -270,7 +270,12 @@ class ReplicaPool:
             # the front door. Re-dispatch after a death re-routes with
             # the same key — the ring has already moved the arc to the
             # successor, which (tier_root set) rehydrates the dead
-            # replica's spill.
+            # replica's spill. Geometry-coarsening replicas stay
+            # ring-consistent for free: the raw support bytes hash here,
+            # and every replica coarsens them onto the same lattice entry
+            # (serve/geometry.py orders the lattice deterministically),
+            # so one episode always lands in one coarsened bucket on one
+            # replica.
             try:
                 routing_key = routing_digest(
                     np.asarray(x_support), np.asarray(y_support)

@@ -1,0 +1,271 @@
+"""ANIL (Raghu et al.) on the full shared learner contract.
+
+ANIL is MAML with the inner loop restricted to the classifier head via the
+``adapt_mask`` partition seam (models/anil.py); everything else — LSLR,
+MSL, serve split, checkpoint prefix, divergence sentinel, mesh rules — is
+inherited. These tests pin the three things the restriction must mean:
+
+* the ADAPTED set is exactly the head (LSLR table and serve artifact hold
+  ``linear/weight`` + ``linear/bias`` and nothing else);
+* the body is frozen THROUGH ADAPTATION but still meta-trained (conv
+  leaves move under ``run_train_iter``, never inside ``serve_adapt``);
+* every shared-contract surface (serve parity incl. trained state and the
+  uint8 wire, dp-mesh training, mesh-portable checkpoints, the nonfinite
+  sentinel, serve compile-once) holds for the subclass unchanged.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    ANILLearner,
+    BackboneConfig,
+    MAMLConfig,
+)
+from howtotrainyourmamlpytorch_tpu.models.common import WireCodec
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+from howtotrainyourmamlpytorch_tpu.serve import ServeConfig, ServingAPI
+from howtotrainyourmamlpytorch_tpu.utils.trees import partition
+from test_serve_parity import (
+    eval_batch,
+    golden_fixture_episode,
+    serve_and_reference,
+    tiny_cfg,
+)
+
+HEAD_LEAVES = 2  # linear/weight + linear/bias
+
+
+def small_cfg(**kw):
+    """8x8 config for the non-parity tests (parity rides test_serve_parity's
+    14x14 ``tiny_cfg`` because the golden fixtures are recorded at 14x14)."""
+    kw.setdefault("second_order", False)
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        **kw,
+    )
+
+
+def small_batch(rng, tasks=2, hw=8):
+    xs = rng.randn(tasks, 5, 1, 1, hw, hw).astype(np.float32)
+    xt = rng.randn(tasks, 5, 1, 1, hw, hw).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(np.int32)
+    return xs, xt, ys, ys.copy()
+
+
+def head_paths(tree):
+    """Top-level path groups of the tree's non-None leaves."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(path[:1]) for path, _ in flat}
+
+
+# ---------------------------------------------------------------------------
+# The partition IS the specialization
+# ---------------------------------------------------------------------------
+
+
+def test_adapt_partition_is_exactly_the_head():
+    learner = ANILLearner(small_cfg())
+    state = learner.init_state(jax.random.key(0))
+    adapt, _frozen = partition(state.theta, learner.adapt_mask(state.theta))
+    assert len(jax.tree.leaves(adapt)) == HEAD_LEAVES
+    assert head_paths(adapt) == {"['linear']"}
+    # LSLR is sized FROM the partition: head rows only, nothing for the body.
+    assert len(jax.tree.leaves(state.lslr)) == HEAD_LEAVES
+    assert head_paths(state.lslr) == {"['linear']"}
+
+
+def test_serve_artifact_is_head_only_and_tiny():
+    """``serve_adapt`` returns only the adapted partition — for ANIL a
+    kilobyte-scale head, not MAML's full fast-weight tree."""
+    learner = ANILLearner(small_cfg())
+    istate = learner.init_inference_state(jax.random.key(1))
+    rng = np.random.RandomState(1)
+    xs = rng.rand(5, 1, 8, 8).astype(np.float32)
+    ys = np.arange(5, dtype=np.int32)
+    artifact = learner.serve_adapt(istate, xs, ys)
+    leaves = jax.tree.leaves(artifact)
+    assert len(leaves) == HEAD_LEAVES
+    assert head_paths(artifact) == {"['linear']"}
+    assert sum(np.asarray(l).nbytes for l in leaves) < 16 * 1024
+
+
+def test_body_frozen_through_adaptation_but_meta_trained(rng):
+    """Adaptation must not touch conv leaves (they are not even IN the
+    adapted tree); the outer loop must still train them."""
+    learner = ANILLearner(small_cfg())
+    state = learner.init_state(jax.random.key(2))
+    # Host copies up front: the train step donates its input state buffers.
+    before = [
+        (path, np.array(leaf))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state.theta)[0]
+    ]
+    new_state, losses = learner.run_train_iter(
+        state, small_batch(rng), epoch=0
+    )
+    assert float(losses["nonfinite"]) == 0.0
+    after = dict(jax.tree_util.tree_flatten_with_path(new_state.theta)[0])
+    body_moved = 0
+    for path, leaf in before:
+        if jax.tree_util.keystr(path[:1]) == "['linear']":
+            continue
+        if not np.array_equal(leaf, np.asarray(after[path])):
+            body_moved += 1
+    assert body_moved > 0, "outer loop must meta-train the frozen body"
+
+
+def test_second_order_is_legal_and_differs_from_first_order(rng):
+    """The outer gradient differentiates THROUGH the head-only inner loop:
+    a second-order step must run and produce different head weights than
+    the first-order approximation from the same init and batch."""
+    batch = small_batch(rng)
+    heads = {}
+    for so in (False, True):
+        learner = ANILLearner(small_cfg(second_order=so))
+        state = learner.init_state(jax.random.key(3))
+        state, losses = learner.run_train_iter(state, batch, epoch=0)
+        assert float(losses["nonfinite"]) == 0.0
+        adapt, _ = partition(state.theta, learner.adapt_mask(state.theta))
+        heads[so] = [np.asarray(l) for l in jax.tree.leaves(adapt)]
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(heads[False], heads[True])
+    )
+
+
+# ---------------------------------------------------------------------------
+# Serve parity (bit-exact vs the eval graph)
+# ---------------------------------------------------------------------------
+
+
+def test_anil_served_fixture_episode_bit_exact():
+    learner = ANILLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(4))
+    xs, ys, xq, yq = golden_fixture_episode()
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_anil_trained_state_bit_exact(rng):
+    learner = ANILLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(5))
+    state, _ = learner.run_train_iter(
+        state, small_batch(rng, tasks=2, hw=14), epoch=0
+    )
+    xs, ys, xq, yq = golden_fixture_episode()
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_anil_uint8_wire_codec_bit_exact():
+    learner = ANILLearner(tiny_cfg(wire_codec=WireCodec(1.0, None, None)))
+    state = learner.init_state(jax.random.key(6))
+    xs, ys, xq, yq = golden_fixture_episode(binary=True)
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+# ---------------------------------------------------------------------------
+# dp mesh + mesh-portable checkpoints
+# ---------------------------------------------------------------------------
+
+
+def dp_mesh(n):
+    return make_mesh(jax.devices()[:n], data_parallel=n, model_parallel=1)
+
+
+def test_anil_dp_mesh_train_runs(spmd_fo_compile_guard, rng):
+    learner = ANILLearner(small_cfg(), mesh=dp_mesh(4))
+    state = learner.shard_state(learner.init_state(jax.random.key(7)))
+    for _ in range(2):
+        state, losses = learner.run_train_iter(
+            state, small_batch(rng, tasks=4), epoch=0
+        )
+    assert float(losses["nonfinite"]) == 0.0
+    assert np.isfinite(float(losses["loss"]))
+    for leaf in jax.tree.leaves(state.theta):
+        assert isinstance(leaf.sharding, NamedSharding)
+        assert leaf.sharding.mesh.shape == learner.mesh.shape
+
+
+def test_anil_mesh_checkpoint_roundtrip(tmp_path):
+    """Save under a 2-device dp mesh, resume single-device: bit-exact, and
+    the restored LSLR tree keeps its head-only structure."""
+    writer = ANILLearner(small_cfg(), mesh=dp_mesh(2))
+    state = writer.shard_state(writer.init_state(jax.random.key(8)))
+    exp = {"current_iter": 9}
+    writer.save_model(os.path.join(tmp_path, "train_model_9"), state, exp)
+
+    reader = ANILLearner(small_cfg())
+    restored, restored_exp = reader.load_model(str(tmp_path), "train_model", 9)
+    assert restored_exp == exp
+    saved = [np.asarray(x) for x in jax.tree.leaves(writer.gather_state(state))]
+    back = [np.asarray(x) for x in jax.tree.leaves(restored)]
+    for a, b in zip(saved, back):
+        np.testing.assert_array_equal(a, b)
+    assert len(jax.tree.leaves(restored.lslr)) == HEAD_LEAVES
+
+
+# ---------------------------------------------------------------------------
+# Sentinel + compile discipline
+# ---------------------------------------------------------------------------
+
+
+def test_anil_nonfinite_sentinel_trips(rng):
+    learner = ANILLearner(small_cfg(skip_nonfinite_updates=True))
+    state = learner.init_state(jax.random.key(9))
+    clean = small_batch(rng)
+    state, losses = learner.run_train_iter(state, clean, epoch=0)
+    assert float(losses["nonfinite"]) == 0.0
+    theta_before = [np.asarray(l) for l in jax.tree.leaves(state.theta)]
+    poisoned = (np.full_like(clean[0], np.inf),) + clean[1:]
+    state, losses = learner.run_train_iter(state, poisoned, epoch=0)
+    assert float(losses["nonfinite"]) == 1.0
+    # skip_nonfinite_updates: the poisoned step must not move theta.
+    for a, b in zip(theta_before, jax.tree.leaves(state.theta)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_anil_serve_compiles_once(compile_guard):
+    """Distinct support sets at one geometry reuse the one adapt/classify
+    program pair — no per-episode recompiles."""
+    learner = ANILLearner(small_cfg())
+    state = learner.init_state(jax.random.key(10))
+    api = ServingAPI(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    rng = np.random.RandomState(11)
+
+    def episode():
+        xs = rng.rand(5, 1, 8, 8).astype(np.float32)
+        ys = np.arange(5, dtype=np.int32)
+        xq = rng.rand(3, 1, 8, 8).astype(np.float32)
+        return xs, ys, xq
+
+    try:
+        api.classify(*episode())  # warm: compiles the pair once
+        with compile_guard() as guard:
+            for _ in range(3):
+                out = api.classify(*episode())
+                assert out["logits"].shape == (3, 5)
+        assert guard.count("serve_adapt_anil") == 0
+        assert guard.count("serve_classify_anil") == 0
+        assert len(guard.events) == 0
+    finally:
+        api.close()

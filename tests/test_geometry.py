@@ -1,0 +1,435 @@
+"""Episode-geometry coarsening (serve/geometry.py): mixed (way, shot,
+query) traffic through a fixed program set.
+
+Three layers of contract, pinned in order:
+
+* the POLICY: deterministic lattice ordering (slot cost, then
+  lexicographic — a fleet must agree on the bucket an episode rides),
+  coarsen-to-first-containing, actionable rejection, and structurally-zero
+  padding with a correct mask;
+* the NUMERICS: for every learner family, logits over the REAL classes of
+  a coarsened dispatch are bit-exact with a dispatch at the episode's true
+  geometry. For MAML/ANIL/GD/protonets that anchor extends to the
+  pre-geometry MASKLESS engine bit-for-bit; matching nets' attention
+  softmax fuses differently once the mask is a runtime input (~1 ulp,
+  identical argmax — see the geometry.py docstring fine print), so its
+  bit-exact anchor is the masked program at the true geometry;
+* the COMPILE ECONOMY: a mixed stream of >= 6 distinct geometries compiles
+  at most the declared bucket set (one masked adapt per bucket; classify
+  shared across buckets with equal query count), and the second pass over
+  the same mix compiles nothing.
+
+Plus the observability/front-door seams: the ``coarsened`` response flag,
+``geometry_coarsened_total`` / ``geometry_rejected_total`` counters, the
+HTTP 400 (NOT 503: no Retry-After, no shed flag) rejection path, and the
+/metrics scrape.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.data import synthesize_episode
+from howtotrainyourmamlpytorch_tpu.models import (
+    ANILLearner,
+    BackboneConfig,
+    GradientDescentLearner,
+    MAMLConfig,
+    MAMLFewShotLearner,
+    MatchingNetsLearner,
+    ProtoNetsLearner,
+)
+from howtotrainyourmamlpytorch_tpu.serve import (
+    ServeConfig,
+    ServingAPI,
+    make_http_server,
+)
+from howtotrainyourmamlpytorch_tpu.serve.geometry import (
+    GeometryPolicy,
+    GeometryRejectedError,
+)
+
+FAMILIES = {
+    "maml": MAMLFewShotLearner,
+    "anil": ANILLearner,
+    "gradient_descent": GradientDescentLearner,
+    "matching_nets": MatchingNetsLearner,
+    "protonets": ProtoNetsLearner,
+}
+
+#: Exactly-bit-exact against the pre-geometry maskless engine too (the
+#: matching-nets exception is the module-docstring fine print).
+MASKLESS_EXACT = {"maml", "anil", "gradient_descent", "protonets"}
+
+LATTICE = ((3, 1, 4), (5, 2, 8))
+
+#: Six distinct geometries, all containable by LATTICE: two exact fits,
+#: four that must coarsen.
+MIX = ((2, 1, 3), (3, 1, 4), (2, 2, 5), (4, 1, 6), (5, 1, 8), (5, 2, 8))
+
+IMAGE = (1, 8, 8)
+
+
+def geo_cfg(**kw):
+    """layer_norm backbone — the row-independence precondition the policy
+    validates at attachment."""
+    kw.setdefault("second_order", False)
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+            norm_layer="layer_norm",
+        ),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        **kw,
+    )
+
+
+def serve_cfg(lattice=LATTICE, **kw):
+    kw.setdefault("meta_batch_size", 2)
+    kw.setdefault("max_wait_ms", 0.0)
+    return ServeConfig(geometry_lattice=lattice, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Policy: lattice order, coarsening map, rejection, padding
+# ---------------------------------------------------------------------------
+
+
+def test_lattice_sorted_by_slot_cost_then_lexicographic_and_deduped():
+    policy = GeometryPolicy([(5, 2, 8), (3, 1, 4), (3, 1, 4), (2, 2, 2)])
+    # slot costs: (2,2,2)->6, (3,1,4)->7, (5,2,8)->18
+    assert policy.lattice == ((2, 2, 2), (3, 1, 4), (5, 2, 8))
+    assert policy.describe() == "2x2x2, 3x1x4, 5x2x8"
+
+
+def test_equal_cost_ties_resolve_lexicographically():
+    # Both cost 6; a fleet must coarsen (2,1,2) identically everywhere.
+    policy = GeometryPolicy([(3, 1, 3), (2, 2, 2)])
+    assert policy.lattice == ((2, 2, 2), (3, 1, 3))
+    assert policy.coarsen(2, 1, 2) == (2, 2, 2)
+    assert policy.coarsen(3, 1, 1) == (3, 1, 3)
+
+
+def test_coarsen_table():
+    policy = GeometryPolicy(LATTICE)
+    cases = {
+        (2, 1, 3): (3, 1, 4),
+        (3, 1, 4): (3, 1, 4),  # exact fit
+        (2, 2, 5): (5, 2, 8),  # shot forces the big bucket
+        (4, 1, 6): (5, 2, 8),  # query forces it
+        (5, 1, 8): (5, 2, 8),
+        (5, 2, 8): (5, 2, 8),  # exact fit
+    }
+    for geometry, bucket in cases.items():
+        assert policy.coarsen(*geometry) == bucket
+
+
+def test_rejection_is_actionable_and_not_overload():
+    policy = GeometryPolicy(LATTICE)
+    with pytest.raises(GeometryRejectedError) as exc_info:
+        policy.coarsen(5, 3, 2)  # shot 3 fits no bucket
+    msg = str(exc_info.value)
+    assert policy.describe() in msg, "message must name the lattice"
+    assert "not overload" in msg
+    assert isinstance(exc_info.value, ValueError)  # the existing 400 map
+
+
+def test_bad_lattice_entries_refused():
+    with pytest.raises(ValueError):
+        GeometryPolicy([])
+    with pytest.raises(ValueError):
+        GeometryPolicy([(5, 0, 2)])
+    with pytest.raises(ValueError):
+        GeometryPolicy([(5, 2)])
+
+
+def test_pad_episode_structure():
+    policy = GeometryPolicy(LATTICE)
+    xs, ys, xq = synthesize_episode(2, 1, 3, image_shape=IMAGE, seed=5)
+    padded = policy.pad_episode(xs, ys, xq, way=2, shot=1)
+    assert (padded.way, padded.shot, padded.query) == (3, 1, 4)
+    assert (padded.real_way, padded.real_shot, padded.real_query) == (2, 1, 3)
+    assert padded.coarsened
+    # Real rows are a contiguous, untouched prefix; padding is exact zeros
+    # with label 0 and mask 0.
+    np.testing.assert_array_equal(padded.x_support[:2], xs)
+    np.testing.assert_array_equal(padded.y_support[:2], ys)
+    np.testing.assert_array_equal(padded.x_query[:3], xq)
+    np.testing.assert_array_equal(
+        padded.x_support[2:], np.zeros((1,) + IMAGE, np.float32)
+    )
+    np.testing.assert_array_equal(
+        padded.x_query[3:], np.zeros((1,) + IMAGE, np.float32)
+    )
+    np.testing.assert_array_equal(padded.y_support[2:], [0])
+    np.testing.assert_array_equal(padded.support_mask, [1.0, 1.0, 0.0])
+    assert padded.support_mask.dtype == np.float32
+
+    exact = policy.pad_episode(
+        *synthesize_episode(5, 2, 8, image_shape=IMAGE, seed=6), way=5, shot=2
+    )
+    assert not exact.coarsened
+    np.testing.assert_array_equal(exact.support_mask, np.ones(10, np.float32))
+
+
+def backbone(**kw):
+    return BackboneConfig(
+        num_stages=2,
+        num_filters=4,
+        num_classes=5,
+        image_height=8,
+        image_width=8,
+        num_steps=2,
+        **kw,
+    )
+
+
+def test_validate_backbone_refuses_batch_norm_and_narrow_heads():
+    policy = GeometryPolicy(LATTICE)
+    with pytest.raises(ValueError, match="row-independent"):
+        policy.validate_backbone(backbone())  # batch_norm default
+    narrow = GeometryPolicy(((7, 1, 4),))
+    with pytest.raises(ValueError, match="only 5 classes"):
+        narrow.validate_backbone(backbone(norm_layer="layer_norm"))
+
+
+def test_engine_refuses_batch_norm_backbone():
+    bad = MAMLConfig(
+        backbone=backbone(),  # batch_norm default
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        use_multi_step_loss_optimization=False,
+        second_order=False,
+    )
+    learner = MAMLFewShotLearner(bad)
+    state = learner.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="row-independent"):
+        ServingAPI(learner, state, serve_cfg())
+
+
+def test_engine_refuses_lattice_wider_than_head():
+    learner = MAMLFewShotLearner(geo_cfg())
+    state = learner.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="only 5 classes"):
+        ServingAPI(learner, state, serve_cfg(lattice=((7, 1, 4),)))
+
+
+# ---------------------------------------------------------------------------
+# Numerics: the real-class slice of a coarsened dispatch is bit-exact
+# ---------------------------------------------------------------------------
+
+
+def classify_once(api, episode):
+    xs, ys, xq = episode
+    return api.classify(xs, ys, xq)
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_coarsened_logits_bit_exact_real_slice(family):
+    learner = FAMILIES[family](geo_cfg())
+    state = learner.init_state(jax.random.key(1))
+    episode = synthesize_episode(2, 1, 3, image_shape=IMAGE, seed=7)
+
+    api_geo = ServingAPI(learner, state, serve_cfg())
+    api_fit = ServingAPI(learner, state, serve_cfg(lattice=((2, 1, 3),)))
+    api_plain = ServingAPI(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    try:
+        coarse = classify_once(api_geo, episode)
+        fit = classify_once(api_fit, episode)
+        plain = classify_once(api_plain, episode)
+    finally:
+        api_geo.close()
+        api_fit.close()
+        api_plain.close()
+
+    assert coarse["coarsened"] and coarse["bucket"] == "3x1x4"
+    assert not fit["coarsened"] and fit["bucket"] == "2x1x3"
+    assert not plain["coarsened"] and plain["bucket"] == "2x1x3"
+
+    logits = np.asarray(coarse["logits"])
+    # Padded query rows dropped; padded class columns can never win.
+    assert logits.shape == (3, 5)
+    assert np.isneginf(logits[:, 2:]).all()
+    assert np.isfinite(logits[:, :2]).all()
+
+    # Coarsened == masked dispatch at the TRUE geometry, bit-for-bit, for
+    # every family: padding is never lossy.
+    np.testing.assert_array_equal(
+        logits[:, :2], np.asarray(fit["logits"])[:, :2]
+    )
+    plain_logits = np.asarray(plain["logits"])
+    if family in MASKLESS_EXACT:
+        np.testing.assert_array_equal(logits[:, :2], plain_logits[:, :2])
+    else:  # matching nets: ~1 ulp vs the maskless fusion, same argmax
+        np.testing.assert_allclose(
+            logits[:, :2], plain_logits[:, :2], rtol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.argmax(logits[:, :2], axis=-1),
+            np.argmax(plain_logits[:, :2], axis=-1),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Compile economy: the mix rides the lattice's program set
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_stream_compiles_at_most_the_lattice(compile_guard):
+    assert len(set(MIX)) >= 6
+    learner = MAMLFewShotLearner(geo_cfg())
+    state = learner.init_state(jax.random.key(2))
+    api = ServingAPI(learner, state, serve_cfg())
+    try:
+        with compile_guard() as guard:
+            api.engine.warmup()  # a geometry engine warms its whole lattice
+            for i, geometry in enumerate(MIX):
+                episode = synthesize_episode(
+                    *geometry, image_shape=IMAGE, seed=100 + i
+                )
+                out = classify_once(api, episode)
+                assert np.asarray(out["logits"]).shape == (geometry[2], 5)
+        # One masked adapt program per bucket; LATTICE's buckets have
+        # distinct query counts so classify is also one per bucket.
+        guard.assert_compiles("serve_adapt_maml", exactly=len(LATTICE))
+        guard.assert_compiles("serve_classify_maml", exactly=len(LATTICE))
+        assert len(guard.events) == 2 * len(LATTICE)
+
+        # Steady state: a second pass over the same mix compiles NOTHING.
+        with compile_guard() as steady:
+            for i, geometry in enumerate(MIX):
+                episode = synthesize_episode(
+                    *geometry, image_shape=IMAGE, seed=200 + i
+                )
+                classify_once(api, episode)
+        assert len(steady.events) == 0
+        # The engine's own trace table agrees: 2 adapt + 2 classify shapes.
+        assert len(api.engine.compile_table()) == 2 * len(LATTICE)
+    finally:
+        api.close()
+
+
+def test_shared_classify_program_across_equal_query_buckets(compile_guard):
+    """Buckets that differ only in support geometry share ONE classify
+    program — the query-side shape is the whole classify signature."""
+    lattice = ((2, 1, 6), (5, 2, 6))
+    learner = MAMLFewShotLearner(geo_cfg())
+    state = learner.init_state(jax.random.key(3))
+    api = ServingAPI(learner, state, serve_cfg(lattice=lattice))
+    try:
+        with compile_guard() as guard:
+            api.engine.warmup()
+        guard.assert_compiles("serve_adapt_maml", exactly=2)
+        guard.assert_compiles("serve_classify_maml", exactly=1)
+    finally:
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# Observability + front door
+# ---------------------------------------------------------------------------
+
+
+def test_geometry_counters_and_rejection():
+    learner = MAMLFewShotLearner(geo_cfg())
+    state = learner.init_state(jax.random.key(4))
+    api = ServingAPI(learner, state, serve_cfg())
+    try:
+        classify_once(api, synthesize_episode(3, 1, 4, image_shape=IMAGE))
+        snap = api.metrics.snapshot()
+        assert snap["geometry_coarsened_total"] == 0  # exact fit
+        classify_once(
+            api, synthesize_episode(2, 1, 3, image_shape=IMAGE, seed=1)
+        )
+        with pytest.raises(GeometryRejectedError):
+            classify_once(
+                api, synthesize_episode(5, 3, 2, image_shape=IMAGE, seed=2)
+            )
+        snap = api.metrics.snapshot()
+        assert snap["geometry_coarsened_total"] == 1
+        assert snap["geometry_rejected_total"] == 1
+    finally:
+        api.close()
+
+
+@pytest.fixture
+def served_geo():
+    learner = MAMLFewShotLearner(geo_cfg())
+    state = learner.init_state(jax.random.key(5))
+    api = ServingAPI(learner, state, serve_cfg(max_wait_ms=1.0))
+    api.engine.warmup()
+    server = make_http_server(api, port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{port}", api
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+        api.close()
+
+
+def post_episode(base, payload):
+    req = urllib.request.Request(
+        f"{base}/v1/episode",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.load(resp)
+
+
+def episode_payload(way, shot, query, seed=0):
+    xs, ys, xq = synthesize_episode(
+        way, shot, query, image_shape=IMAGE, seed=seed
+    )
+    return {
+        "support": xs.tolist(),
+        "support_labels": ys.tolist(),
+        "query": xq.tolist(),
+    }
+
+
+def test_http_geometry_rejection_is_400_not_overload(served_geo):
+    base, _api = served_geo
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        post_episode(base, episode_payload(5, 3, 2))
+    err = exc_info.value
+    assert err.code == 400
+    body = json.load(err)
+    assert body["geometry_rejected"] is True
+    assert "3x1x4" in body["error"] and "not overload" in body["error"]
+    # Deliberately NOT shaped like overload: no shed flag, no Retry-After.
+    assert "shed" not in body
+    assert err.headers.get("Retry-After") is None
+
+
+def test_http_coarsened_roundtrip_and_metrics_scrape(served_geo):
+    base, _api = served_geo
+    status, body = post_episode(base, episode_payload(2, 1, 3, seed=3))
+    assert status == 200
+    assert body["coarsened"] is True
+    assert body["bucket"] == "3x1x4"
+    assert np.asarray(body["logits"]).shape == (3, 5)
+    assert max(body["predictions"]) < 2  # -inf pad columns never win
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    assert "maml_serve_geometry_coarsened_total 1" in text
+    assert "maml_serve_geometry_rejected_total 0" in text

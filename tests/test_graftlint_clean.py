@@ -34,7 +34,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LINT_TARGETS = [
     "howtotrainyourmamlpytorch_tpu", "tests", "tools",
     "train_maml_system.py", "train_gradient_descent_system.py",
-    "train_matching_nets_system.py", "train_maml_system_dispatch.py",
+    "train_matching_nets_system.py", "train_anil_system.py",
+    "train_protonets_system.py", "train_maml_system_dispatch.py",
     "bench.py",
 ]
 
@@ -120,7 +121,8 @@ PLANES = {
         "targets": [
             f"{PKG}/parallel", "train_maml_system.py",
             "train_gradient_descent_system.py",
-            "train_matching_nets_system.py", "train_maml_system_dispatch.py",
+            "train_matching_nets_system.py", "train_anil_system.py",
+            "train_protonets_system.py", "train_maml_system_dispatch.py",
             "tools/serve_maml.py", "tools/chaos_train.py", "bench.py",
         ],
         "expect": {"distributed.py", "mesh.py", "multihost.py",
@@ -165,6 +167,28 @@ PLANES = {
         "targets": [f"{PKG}/serve/tier"],
         "expect": {"__init__.py", "atomic.py", "spill.py", "execcache.py",
                    "ring.py"},
+        "zero_suppressions": True,
+    },
+    "learner-zoo": {
+        # ISSUE 19: the two new learner families (head-only ANIL, metric
+        # protonets) plus their entry points lint clean standalone with
+        # zero suppressions — the shared-contract peers earn no carve-outs.
+        "targets": [
+            f"{PKG}/models/anil.py", f"{PKG}/models/protonets.py",
+            "train_anil_system.py", "train_protonets_system.py",
+        ],
+        "expect": {"anil.py", "protonets.py", "train_anil_system.py",
+                   "train_protonets_system.py"},
+        "zero_suppressions": True,
+    },
+    "geometry": {
+        # ISSUE 19: the episode-geometry subsystem (coarsening policy +
+        # its synthetic traffic generator) is pure host-side numpy and
+        # must stay that way — zero suppressions.
+        "targets": [
+            f"{PKG}/serve/geometry.py", f"{PKG}/data/synth_geometry.py",
+        ],
+        "expect": {"geometry.py", "synth_geometry.py"},
         "zero_suppressions": True,
     },
     "program-plane": {

@@ -1,0 +1,268 @@
+"""Prototypical networks (Snell et al. 2017) on the shared contract.
+
+ProtoNets is the metric-learning end of the learner zoo: no inner loop at
+all — ``serve_adapt`` is an embed + per-class mean, and the cacheable
+artifact is a ``(num_classes, feat)`` prototype table. These tests pin the
+prototype math against numpy references, then run the learner through
+every shared-contract surface: serve parity bit-exact vs
+``run_validation_iter`` (init state, trained state, uint8 wire), training
+actually learns a separable batch, dp-mesh training, mesh-portable
+checkpoints, the nonfinite sentinel, and serve compile-once.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from howtotrainyourmamlpytorch_tpu.models import (
+    BackboneConfig,
+    MAMLConfig,
+    ProtoNetsLearner,
+    ProtoNetsState,
+)
+from howtotrainyourmamlpytorch_tpu.models.common import WireCodec
+from howtotrainyourmamlpytorch_tpu.models.protonets import (
+    class_prototypes,
+    squared_distance_logits,
+)
+from howtotrainyourmamlpytorch_tpu.parallel import make_mesh
+from howtotrainyourmamlpytorch_tpu.serve import ServeConfig, ServingAPI
+from test_serve_parity import (
+    golden_fixture_episode,
+    serve_and_reference,
+    tiny_cfg,
+)
+
+
+def small_cfg(**kw):
+    return MAMLConfig(
+        backbone=BackboneConfig(
+            num_stages=2,
+            num_filters=4,
+            num_classes=5,
+            image_height=8,
+            image_width=8,
+            num_steps=2,
+        ),
+        meta_learning_rate=0.01,
+        **kw,
+    )
+
+
+def small_batch(rng, tasks=2, hw=8):
+    xs = rng.randn(tasks, 5, 1, 1, hw, hw).astype(np.float32)
+    xt = rng.randn(tasks, 5, 1, 1, hw, hw).astype(np.float32)
+    ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 1)).astype(np.int32)
+    return xs, xt, ys, ys.copy()
+
+
+def separable_batch(rng, tasks=2, hw=8):
+    """Each class is a distinct constant image + small noise — linearly
+    separable, so the loss must fall under training."""
+    base = np.linspace(-1.0, 1.0, 5, dtype=np.float32)
+
+    def draw(shot):
+        x = np.zeros((tasks, 5, shot, 1, hw, hw), np.float32)
+        for c in range(5):
+            x[:, c] = base[c] + 0.05 * rng.randn(tasks, shot, 1, hw, hw)
+        return x
+
+    ys = np.tile(np.arange(5)[None, :, None], (tasks, 1, 2)).astype(np.int32)
+    return draw(2), draw(2), ys, ys.copy()
+
+
+# ---------------------------------------------------------------------------
+# Prototype math vs numpy
+# ---------------------------------------------------------------------------
+
+
+def test_class_prototypes_are_per_class_means(rng):
+    emb = rng.randn(10, 7).astype(np.float32)
+    ys = np.repeat(np.arange(5), 2).astype(np.int32)
+    got = np.asarray(class_prototypes(emb, ys, 5))
+    want = np.stack([emb[ys == c].mean(axis=0) for c in range(5)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_class_prototypes_mask_excludes_rows_exactly(rng):
+    """A masked-out row contributes an EXACT zero: prototypes over the real
+    rows are bit-identical whether the padded rows exist or not."""
+    emb_real = rng.randn(6, 7).astype(np.float32)
+    ys_real = np.repeat(np.arange(3), 2).astype(np.int32)
+    unpadded = np.asarray(class_prototypes(emb_real, ys_real, 5))
+
+    emb_pad = np.concatenate([emb_real, rng.randn(4, 7).astype(np.float32)])
+    ys_pad = np.concatenate([ys_real, np.zeros(4, np.int32)])
+    mask = np.concatenate([np.ones(6), np.zeros(4)]).astype(np.float32)
+    padded = np.asarray(class_prototypes(emb_pad, ys_pad, 5, mask))
+    np.testing.assert_array_equal(padded, unpadded)
+
+
+def test_class_prototypes_absent_class_is_zero_not_nan(rng):
+    emb = rng.randn(4, 3).astype(np.float32)
+    ys = np.array([0, 0, 1, 1], np.int32)  # classes 2..4 absent
+    got = np.asarray(class_prototypes(emb, ys, 5))
+    assert np.isfinite(got).all()
+    np.testing.assert_array_equal(got[2:], np.zeros((3, 3), np.float32))
+
+
+def test_squared_distance_logits_vs_numpy(rng):
+    q = rng.randn(4, 6).astype(np.float32)
+    p = rng.randn(5, 6).astype(np.float32)
+    got = np.asarray(squared_distance_logits(q, p))
+    want = -((q[:, None, :] - p[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert got.shape == (4, 5)
+
+
+# ---------------------------------------------------------------------------
+# Serve parity (bit-exact vs the eval graph) + the tiny artifact
+# ---------------------------------------------------------------------------
+
+
+def test_protonets_served_fixture_episode_bit_exact():
+    learner = ProtoNetsLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(0))
+    xs, ys, xq, yq = golden_fixture_episode()
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_protonets_trained_state_bit_exact(rng):
+    learner = ProtoNetsLearner(tiny_cfg())
+    state = learner.init_state(jax.random.key(1))
+    state, losses = learner.run_train_iter(
+        state, small_batch(rng, tasks=2, hw=14), epoch=0
+    )
+    assert float(losses["nonfinite"]) == 0.0
+    xs, ys, xq, yq = golden_fixture_episode()
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_protonets_uint8_wire_codec_bit_exact():
+    learner = ProtoNetsLearner(tiny_cfg(wire_codec=WireCodec(1.0, None, None)))
+    state = learner.init_state(jax.random.key(2))
+    xs, ys, xq, yq = golden_fixture_episode(binary=True)
+    served, cached, ref = serve_and_reference(learner, state, xs, ys, xq, yq)
+    np.testing.assert_array_equal(served, ref)
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_serve_artifact_is_a_prototype_table(rng):
+    """The whole cacheable artifact is one (num_classes, feat) table —
+    the metric tier's cost story in one assert."""
+    learner = ProtoNetsLearner(small_cfg())
+    istate = learner.init_inference_state(jax.random.key(3))
+    xs = rng.rand(5, 1, 8, 8).astype(np.float32)
+    ys = np.arange(5, dtype=np.int32)
+    artifact = learner.serve_adapt(istate, xs, ys)
+    assert set(artifact) == {"prototypes"}
+    protos = np.asarray(artifact["prototypes"])
+    assert protos.shape[0] == 5
+    assert protos.nbytes < 8 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Training learns; mesh; checkpoints; sentinel; compile discipline
+# ---------------------------------------------------------------------------
+
+
+def test_protonets_training_reduces_loss(rng):
+    learner = ProtoNetsLearner(small_cfg())
+    state = learner.init_state(jax.random.key(4))
+    batch = separable_batch(rng)
+    state, first = learner.run_train_iter(state, batch, epoch=0)
+    first_loss = float(first["loss"])
+    for _ in range(20):
+        state, losses = learner.run_train_iter(state, batch, epoch=0)
+    assert float(losses["nonfinite"]) == 0.0
+    assert float(losses["loss"]) < first_loss
+    assert float(losses["accuracy"]) > 0.9
+
+
+def dp_mesh(n):
+    return make_mesh(jax.devices()[:n], data_parallel=n, model_parallel=1)
+
+
+def test_protonets_dp_mesh_train_runs(spmd_fo_compile_guard, rng):
+    learner = ProtoNetsLearner(small_cfg(), mesh=dp_mesh(4))
+    state = learner.shard_state(learner.init_state(jax.random.key(5)))
+    for _ in range(2):
+        state, losses = learner.run_train_iter(
+            state, small_batch(rng, tasks=4), epoch=0
+        )
+    assert float(losses["nonfinite"]) == 0.0
+    assert np.isfinite(float(losses["loss"]))
+    for leaf in jax.tree.leaves(state.theta):
+        assert isinstance(leaf.sharding, NamedSharding)
+        assert leaf.sharding.mesh.shape == learner.mesh.shape
+
+
+def test_protonets_mesh_checkpoint_roundtrip(tmp_path):
+    """The reverse direction of test_anil's: save single-device, resume
+    onto a 2-device dp mesh — bit-exact, restored leaves on the mesh."""
+    writer = ProtoNetsLearner(small_cfg())
+    state = writer.init_state(jax.random.key(6))
+    exp = {"current_iter": 3}
+    writer.save_model(os.path.join(tmp_path, "train_model_3"), state, exp)
+
+    reader = ProtoNetsLearner(small_cfg(), mesh=dp_mesh(2))
+    restored, restored_exp = reader.load_model(str(tmp_path), "train_model", 3)
+    assert restored_exp == exp
+    assert isinstance(restored, ProtoNetsState)
+    saved = [np.asarray(x) for x in jax.tree.leaves(writer.gather_state(state))]
+    back = [
+        np.asarray(x) for x in jax.tree.leaves(reader.gather_state(restored))
+    ]
+    for a, b in zip(saved, back):
+        np.testing.assert_array_equal(a, b)
+    for leaf in jax.tree.leaves(restored):
+        assert isinstance(leaf.sharding, NamedSharding)
+        assert leaf.sharding.mesh.shape == reader.mesh.shape
+
+
+def test_protonets_nonfinite_sentinel_trips(rng):
+    learner = ProtoNetsLearner(small_cfg(skip_nonfinite_updates=True))
+    state = learner.init_state(jax.random.key(7))
+    clean = small_batch(rng)
+    state, losses = learner.run_train_iter(state, clean, epoch=0)
+    assert float(losses["nonfinite"]) == 0.0
+    theta_before = [np.asarray(l) for l in jax.tree.leaves(state.theta)]
+    poisoned = (np.full_like(clean[0], np.inf),) + clean[1:]
+    state, losses = learner.run_train_iter(state, poisoned, epoch=0)
+    assert float(losses["nonfinite"]) == 1.0
+    for a, b in zip(theta_before, jax.tree.leaves(state.theta)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_protonets_serve_compiles_once(compile_guard):
+    learner = ProtoNetsLearner(small_cfg())
+    state = learner.init_state(jax.random.key(8))
+    api = ServingAPI(
+        learner, state, ServeConfig(meta_batch_size=2, max_wait_ms=0.0)
+    )
+    rng = np.random.RandomState(9)
+
+    def episode():
+        xs = rng.rand(5, 1, 8, 8).astype(np.float32)
+        ys = np.arange(5, dtype=np.int32)
+        xq = rng.rand(3, 1, 8, 8).astype(np.float32)
+        return xs, ys, xq
+
+    try:
+        api.classify(*episode())  # warm
+        with compile_guard() as guard:
+            for _ in range(3):
+                out = api.classify(*episode())
+                assert out["logits"].shape == (3, 5)
+        assert guard.count("serve_adapt_protonets") == 0
+        assert guard.count("serve_classify_protonets") == 0
+        assert len(guard.events) == 0
+    finally:
+        api.close()

@@ -42,6 +42,44 @@ MANIFEST_SCHEMA = 1
 SEED_TAG_PREFIX = "seed:"
 
 
+def family_bucket_stats(events) -> dict[tuple[str, str], dict]:
+    """Folds ``serve_dispatch`` events into per-(learner family, bucket)
+    traffic stats — the zoo-era view of a telemetry stream that may mix
+    MAML/ANIL/protonets replicas and coarsened geometry traffic::
+
+        {(family, bucket): {"dispatches": n, "episodes": n,
+                            "coarsened": n, "min_margin": x}}
+
+    ``bucket`` is the COARSENED ``"WxSxQ"`` string the dispatch actually
+    rode (serve/geometry.py), ``coarsened`` counts episodes whose real
+    geometry differed from it, and ``min_margin`` is the hardest episode
+    seen. Events from pre-zoo engines (no ``family`` field) fold under
+    ``"maml"``."""
+    out: dict[tuple[str, str], dict] = {}
+    for event in events:
+        if event.get("type") != "serve_dispatch":
+            continue
+        family = str(event.get("family") or "maml")
+        bucket = str(event.get("bucket") or "?")
+        row = out.setdefault(
+            (family, bucket),
+            {"dispatches": 0, "episodes": 0, "coarsened": 0,
+             "min_margin": None},
+        )
+        row["dispatches"] += 1
+        row["episodes"] += int(event.get("episodes") or 0)
+        row["coarsened"] += int(event.get("coarsened") or 0)
+        margins = [
+            float(m) for m in (event.get("margins") or [])
+            if isinstance(m, (int, float)) and math.isfinite(m)
+        ]
+        if margins:
+            low = min(margins)
+            if row["min_margin"] is None or low < row["min_margin"]:
+                row["min_margin"] = low
+    return out
+
+
 def mine_events(events) -> dict[int, dict]:
     """Folds ``serve_dispatch`` events into per-seed confidence stats:
     ``{seed: {"margin": min_margin, "entropy": max_entropy, "count": n}}``.
@@ -102,12 +140,21 @@ def select_hard_episodes(
     return rows[: max(int(top), 0)]
 
 
-def write_manifest(path: str, episodes: list[dict], source: str) -> dict:
+def write_manifest(
+    path: str, episodes: list[dict], source: str, learner: str | None = None
+) -> dict:
+    """``learner`` (optional, schema-compatible) records which learner
+    family's serving traffic mined these seeds — provenance for a human
+    triaging a mixed-fleet replay set. The training loader reads only
+    ``schema`` and ``episodes[].seed`` and ignores it by construction
+    (data/loader.py ``load_replay_manifest``)."""
     manifest = {
         "schema": MANIFEST_SCHEMA,
         "source": source,
         "episodes": episodes,
     }
+    if learner is not None:
+        manifest["learner"] = learner
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1)
@@ -140,11 +187,17 @@ def main(argv=None) -> int:
         stats, max_margin=opts.max_margin, top=opts.top,
         min_count=opts.min_count,
     )
+    by_family = family_bucket_stats(events)
+    families = sorted({family for family, _bucket in by_family})
     summary = {
         "tagged_episodes": len(stats),
         "mined": len(episodes),
         "out": opts.out if episodes else None,
         "min_margin": episodes[0]["margin"] if episodes else None,
+        "families": {
+            f"{family}/{bucket}": row
+            for (family, bucket), row in sorted(by_family.items())
+        },
     }
     if not episodes:
         # Nothing cleared the gates: write NO manifest and exit non-zero
@@ -160,7 +213,12 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
         return 3
-    write_manifest(opts.out, episodes, source=os.path.abspath(opts.telemetry))
+    write_manifest(
+        opts.out, episodes, source=os.path.abspath(opts.telemetry),
+        # Single-family telemetry stamps its provenance; a mixed-fleet
+        # stream has no one owner, so the optional field is omitted.
+        learner=families[0] if len(families) == 1 else None,
+    )
     if opts.json:
         print(json.dumps(summary))
     else:
@@ -168,6 +226,14 @@ def main(argv=None) -> int:
             f"mined {summary['mined']} hard episode(s) of "
             f"{summary['tagged_episodes']} tagged -> {opts.out}"
         )
+        for (family, bucket), row in sorted(by_family.items()):
+            coarse = (
+                f", {row['coarsened']} coarsened" if row["coarsened"] else ""
+            )
+            print(
+                f"  {family} @ {bucket}: {row['episodes']} episode(s) over "
+                f"{row['dispatches']} dispatch(es){coarse}"
+            )
     return 0
 
 

@@ -361,6 +361,7 @@ class SpecCoverageRule(ProgramRule):
         from howtotrainyourmamlpytorch_tpu.models import (
             MAMLFewShotLearner,
         )
+        from howtotrainyourmamlpytorch_tpu.models.anil import ANILLearner
         from howtotrainyourmamlpytorch_tpu.models.common import (
             _tiny_backbone_kwargs,
         )
@@ -372,6 +373,9 @@ class SpecCoverageRule(ProgramRule):
         )
         from howtotrainyourmamlpytorch_tpu.models.matching_nets import (
             MatchingNetsLearner,
+        )
+        from howtotrainyourmamlpytorch_tpu.models.protonets import (
+            ProtoNetsLearner,
         )
         from howtotrainyourmamlpytorch_tpu.parallel.sharding import (
             DP_STATE_RULES, MP_STATE_RULES, tree_path_name,
@@ -392,8 +396,9 @@ class SpecCoverageRule(ProgramRule):
         # keep the MP table's layer-norm rule live.
         families = [
             (cls, cls.__name__, cfg())
-            for cls in (MAMLFewShotLearner, GradientDescentLearner,
-                        MatchingNetsLearner)
+            for cls in (MAMLFewShotLearner, ANILLearner,
+                        GradientDescentLearner, MatchingNetsLearner,
+                        ProtoNetsLearner)
         ]
         families.append((
             MAMLFewShotLearner,

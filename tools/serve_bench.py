@@ -30,6 +30,17 @@ with the PERF_NOTES.md "Serving path" keys:
                              a 2-replica in-process pool with a replica
                              kill injected mid-stream; recovery is the
                              measured death-to-full-health window;
+* ``protonets_serve_qps`` / ``anil_adapt_p50_ms`` — the learner-zoo keys:
+                             cold episodes/s through the protonets metric
+                             tier (adapt = embed + class mean) and the
+                             p50 dispatch latency of ANIL's head-only
+                             inner loop, same pipeline and synthesis as
+                             the MAML keys;
+* ``geometry_mix_compiles`` — total program traces after a mixed
+                             ``--geometry-mix`` stream through a declared
+                             ``--geometry-lattice`` engine: must hold at
+                             adapt+classify per bucket (heterogeneous
+                             traffic mints no programs);
 * ``serve_cold_ready_s`` / ``serve_replica_ready_s`` / ``serve_tier_hit_qps``
                            — the durable-tier receipt: first build on a
                              fresh tier dir (real compiles + adapts) vs a
@@ -59,27 +70,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
+def parse_geometries(spec: str) -> list[tuple[int, int, int]]:
+    """``"5x1x15,3x2x8"`` -> ``[(5, 1, 15), (3, 2, 8)]`` — the CLI spelling
+    of a geometry mix / lattice (shared with tools/serve_loadtest.py)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dims = tuple(int(d) for d in part.split("x"))
+        if len(dims) != 3:
+            raise ValueError(
+                f"geometry {part!r} must be WxSxQ (e.g. 5x1x15)"
+            )
+        out.append(dims)
+    if not out:
+        raise ValueError(f"no geometries in {spec!r}")
+    return out
+
+
 def build_api(
     tiny: bool,
     max_batch: int,
     max_wait_ms: float,
     cache: int,
     tier_dir: str | None = None,
+    family: str = "maml",
+    geometry_lattice=None,
 ):
     import jax
 
     from howtotrainyourmamlpytorch_tpu.models import (
+        ANILLearner,
         BackboneConfig,
         MAMLConfig,
         MAMLFewShotLearner,
+        ProtoNetsLearner,
     )
     from howtotrainyourmamlpytorch_tpu.serve import ServeConfig, ServingAPI
 
+    # Geometry coarsening's bit-exactness contract requires a
+    # row-independent forward (serve/geometry.py): a lattice flips the
+    # backbone to layer norm; everything else benches the flagship's
+    # per-step batch-norm shapes.
+    norm = {"norm_layer": "layer_norm"} if geometry_lattice else {}
     if tiny:
         cfg = MAMLConfig(
             backbone=BackboneConfig(
                 num_stages=2, num_filters=8, image_height=14, image_width=14,
                 num_classes=5, per_step_bn_statistics=True, num_steps=2,
+                **norm,
             ),
             number_of_training_steps_per_iter=2,
             number_of_evaluation_steps_per_iter=2,
@@ -91,11 +131,17 @@ def build_api(
             backbone=BackboneConfig(
                 num_stages=4, num_filters=64, image_height=28, image_width=28,
                 num_classes=5, per_step_bn_statistics=True, num_steps=5,
+                **norm,
             ),
             number_of_training_steps_per_iter=5,
             number_of_evaluation_steps_per_iter=5,
         )
-    learner = MAMLFewShotLearner(cfg)
+    learner_cls = {
+        "maml": MAMLFewShotLearner,
+        "anil": ANILLearner,
+        "protonets": ProtoNetsLearner,
+    }[family]
+    learner = learner_cls(cfg)
     state = learner.init_inference_state(jax.random.PRNGKey(0))
     return ServingAPI(
         learner,
@@ -105,6 +151,9 @@ def build_api(
             max_wait_ms=max_wait_ms,
             cache_capacity=cache,
             tier_dir=tier_dir,
+            geometry_lattice=(
+                tuple(geometry_lattice) if geometry_lattice else None
+            ),
         ),
     )
 
@@ -182,6 +231,19 @@ def main(argv=None) -> int:
                         help="skip the resilience loadtest phase")
     parser.add_argument("--skip-tier", action="store_true",
                         help="skip the durable-tier warm-respawn phase")
+    parser.add_argument("--skip-zoo", action="store_true",
+                        help="skip the learner-zoo phase (the "
+                        "protonets_serve_qps / anil_adapt_p50_ms keys)")
+    parser.add_argument("--geometry-mix",
+                        default="2x1x3,3x1x5,3x2x8,4x2x10,5x1x15,5x2x15",
+                        help="comma-separated WxSxQ triples streamed "
+                        "through a geometry-lattice engine (seeded "
+                        "data.geometry_mix_episodes traffic); 'off' "
+                        "disables the phase")
+    parser.add_argument("--geometry-lattice", default="5x1x15,5x2x15",
+                        help="declared WxSxQ bucket lattice for the "
+                        "geometry phase — the fixed program set the mix "
+                        "must coarsen onto")
     opts = parser.parse_args(argv)
 
     import jax
@@ -433,6 +495,88 @@ def main(argv=None) -> int:
             faultinject.deactivate()
             lt_pool.close()
 
+    # Learner-zoo phase: the other two families through the SAME serving
+    # pipeline and synthesis. ``protonets_serve_qps`` is the metric tier's
+    # headline — "adapt" is one embedding pass plus a class mean, so the
+    # cold path should sit far above MAML's inner-loop qps;
+    # ``anil_adapt_p50_ms`` is the head-only inner loop's dispatch
+    # latency, the ANIL-vs-MAML serving lever in one number.
+    protonets_serve_qps = anil_adapt_p50_ms = None
+    if not opts.skip_zoo:
+        zoo_budget = max(1.0, opts.budget_s / 4)
+        api_pn = build_api(
+            opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512,
+            family="protonets",
+        )
+        api_pn.engine.warmup([(way, opts.shot, opts.query)])
+        pn_pool = episode_pool(
+            api_pn, n=64, shot=opts.shot, query=opts.query, seed=23
+        )
+        api_pn.engine.cache.clear()
+        api_pn.engine.cache.capacity = 0  # cold: every episode pays adapt
+        protonets_serve_qps = offered_qps(
+            api_pn, pn_pool, zoo_budget, opts.threads, errors=bench_errors
+        )
+        api_pn.close()
+        api_anil = build_api(
+            opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512,
+            family="anil",
+        )
+        api_anil.engine.warmup([(way, opts.shot, opts.query)])
+        anil_pool = episode_pool(
+            api_anil, n=64, shot=opts.shot, query=opts.query, seed=29
+        )
+        api_anil.engine.cache.clear()
+        api_anil.engine.cache.capacity = 0
+        offered_qps(
+            api_anil, anil_pool, zoo_budget, opts.threads,
+            errors=bench_errors,
+        )
+        anil_adapt_p50_ms = api_anil.metrics.adapt_latency.snapshot()[
+            "p50_ms"
+        ]
+        api_anil.close()
+
+    # Geometry phase: a mixed (way, shot, query) stream through a
+    # declared-lattice engine. The receipt is ``geometry_mix_compiles``:
+    # total program traces after serving EVERY geometry in the mix, which
+    # must stay at the warmup bound (adapt + classify per lattice bucket)
+    # — heterogeneous traffic must not mint programs.
+    geometry_keys = None
+    if opts.geometry_mix and opts.geometry_mix != "off":
+        from howtotrainyourmamlpytorch_tpu.data import geometry_mix_episodes
+
+        geo_lattice = parse_geometries(opts.geometry_lattice)
+        geo_mix = parse_geometries(opts.geometry_mix)
+        api_geo = build_api(
+            opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512,
+            geometry_lattice=geo_lattice,
+        )
+        api_geo.engine.warmup()  # every lattice bucket
+        bb_geo = api_geo.engine.learner.cfg.backbone
+        geo_eps = geometry_mix_episodes(
+            4 * len(geo_mix), geo_mix,
+            image_shape=(
+                bb_geo.image_channels, bb_geo.image_height,
+                bb_geo.image_width,
+            ),
+            seed=31,
+        )
+        t0 = time.perf_counter()
+        for xs_, ys_, xq_ in geo_eps:
+            api_geo.classify(xs_, ys_, xq_)
+        geo_wall = time.perf_counter() - t0
+        geo_table = api_geo.engine.compile_table()
+        geo_snap = api_geo.metrics.snapshot()
+        geometry_keys = {
+            "geometry_mix_compiles": sum(geo_table.values()),
+            "geometry_mix_buckets": len(api_geo.engine.geometry.lattice),
+            "geometry_mix_geometries": len(set(geo_mix)),
+            "geometry_mix_qps": round(len(geo_eps) / geo_wall, 3),
+            "geometry_coarsened_total": geo_snap["geometry_coarsened_total"],
+        }
+        api_geo.close()
+
     compile_table = api.engine.compile_table()
     requests_offered = api.metrics.requests_total.value
     result = {
@@ -479,6 +623,15 @@ def main(argv=None) -> int:
             api.metrics.deadline_exceeded_total.value
         ),
     }
+    if protonets_serve_qps is not None:
+        result.update(
+            {
+                "protonets_serve_qps": round(protonets_serve_qps, 3),
+                "anil_adapt_p50_ms": round(anil_adapt_p50_ms, 3),
+            }
+        )
+    if geometry_keys is not None:
+        result.update(geometry_keys)
     if serve_cold_ready_s is not None:
         result.update(
             {

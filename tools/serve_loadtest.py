@@ -290,9 +290,20 @@ def _build_local_target(opts):
     from howtotrainyourmamlpytorch_tpu.serve.resilience.replica import (
         LocalReplica,
     )
-    from tools.serve_bench import build_api
+    from tools.serve_bench import build_api, parse_geometries
 
     tier_dir = getattr(opts, "tier_dir", None)
+    # A --geometry-mix stream needs a lattice-bearing engine: explicit
+    # --geometry-lattice, or (default) the elementwise max of the mix —
+    # one bucket every mixed episode coarsens onto, the maximally
+    # heterogeneous-traffic-through-one-program-set configuration.
+    lattice = None
+    if getattr(opts, "geometry_mix", None):
+        mix = parse_geometries(opts.geometry_mix)
+        if getattr(opts, "geometry_lattice", None):
+            lattice = parse_geometries(opts.geometry_lattice)
+        else:
+            lattice = [tuple(max(g[i] for g in mix) for i in range(3))]
 
     def replica_tier(index: int):
         # Per-replica tier layout matches PoolConfig.tier_root: a
@@ -305,10 +316,13 @@ def _build_local_target(opts):
     def one_api(replica_tier_dir=None):
         api = build_api(
             opts.tiny, opts.max_batch, max_wait_ms=2.0, cache=512,
-            tier_dir=replica_tier_dir,
+            tier_dir=replica_tier_dir, geometry_lattice=lattice,
         )
-        way = api.engine.learner.cfg.backbone.num_classes
-        api.engine.warmup([(way, opts.shot, opts.query)])
+        if lattice is not None:
+            api.engine.warmup()  # every lattice bucket
+        else:
+            way = api.engine.learner.cfg.backbone.num_classes
+            api.engine.warmup([(way, opts.shot, opts.query)])
         return api
 
     if opts.replicas > 0:
@@ -361,6 +375,16 @@ def main(argv=None) -> int:
                         help="distinct support sets cycled by the stream")
     parser.add_argument("--shot", type=int, default=1)
     parser.add_argument("--query", type=int, default=15)
+    parser.add_argument("--geometry-mix", default=None,
+                        help="comma-separated WxSxQ triples: the stream "
+                        "cycles these geometries (seeded "
+                        "data.geometry_mix_episodes episodes) instead of "
+                        "one fixed bucket; in-process targets get a "
+                        "geometry-lattice engine")
+    parser.add_argument("--geometry-lattice", default=None,
+                        help="declared WxSxQ bucket lattice for "
+                        "--geometry-mix in-process targets (default: the "
+                        "elementwise max of the mix, a single bucket)")
     parser.add_argument("--tiny", action="store_true",
                         help="CI-sized model for the in-process target")
     parser.add_argument("--max-batch", type=int, default=4)
@@ -410,10 +434,19 @@ def main(argv=None) -> int:
         image_shape = (bb.image_channels, bb.image_height, bb.image_width)
         way = bb.num_classes
 
-    episodes = synth_episodes(
-        opts.episodes, way=way, shot=opts.shot, query=opts.query,
-        image_shape=image_shape, seed=opts.seed,
-    )
+    if opts.geometry_mix:
+        from howtotrainyourmamlpytorch_tpu.data import geometry_mix_episodes
+        from tools.serve_bench import parse_geometries
+
+        episodes = geometry_mix_episodes(
+            opts.episodes, parse_geometries(opts.geometry_mix),
+            image_shape=image_shape, seed=opts.seed,
+        )
+    else:
+        episodes = synth_episodes(
+            opts.episodes, way=way, shot=opts.shot, query=opts.query,
+            image_shape=image_shape, seed=opts.seed,
+        )
     if opts.kill_replica_at is not None:
         faultinject.activate(
             faultinject.FaultPlan(

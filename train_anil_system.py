@@ -1,0 +1,30 @@
+"""ANIL entry point: MAML's outer loop, inner loop restricted to the
+classifier head (Raghu et al., "Rapid Learning or Feature Reuse?")."""
+
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    default_mesh_from_args,
+    initialize_distributed_from_argv,
+)
+from howtotrainyourmamlpytorch_tpu.models import ANILLearner
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+    args_to_maml_config,
+    get_args,
+)
+
+if __name__ == "__main__":
+    # Multi-host bring-up BEFORE any device probe (no-op without an
+    # explicit flag/config/env signal — parallel/distributed.py).
+    initialize_distributed_from_argv()
+    args, device = get_args()
+    model = ANILLearner(
+        cfg=args_to_maml_config(args),
+        mesh=default_mesh_from_args(args),
+    )
+    maybe_unzip_dataset(args)
+    system = ExperimentBuilder(
+        model=model, data=MetaLearningSystemDataLoader, args=args, device=device
+    )
+    system.run_experiment()

@@ -1,0 +1,31 @@
+"""Prototypical-networks baseline entry point (Snell et al. 2017):
+embedding + class-mean prototypes + squared-Euclidean logits, no inner
+loop."""
+
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningSystemDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment_builder import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    default_mesh_from_args,
+    initialize_distributed_from_argv,
+)
+from howtotrainyourmamlpytorch_tpu.models import ProtoNetsLearner
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import maybe_unzip_dataset
+from howtotrainyourmamlpytorch_tpu.utils.parser_utils import (
+    args_to_maml_config,
+    get_args,
+)
+
+if __name__ == "__main__":
+    # Multi-host bring-up BEFORE any device probe (no-op without an
+    # explicit flag/config/env signal — parallel/distributed.py).
+    initialize_distributed_from_argv()
+    args, device = get_args()
+    model = ProtoNetsLearner(
+        cfg=args_to_maml_config(args),
+        mesh=default_mesh_from_args(args),
+    )
+    maybe_unzip_dataset(args)
+    system = ExperimentBuilder(
+        model=model, data=MetaLearningSystemDataLoader, args=args, device=device
+    )
+    system.run_experiment()
