@@ -78,7 +78,7 @@ EMITTED_KEYS = (
     "train_recovery_s",
     "promotion_downtime_ms", "rollback_mttr_s",
     "sentinel_before_ms", "sentinel_after_ms", "quiet_sentinel_norm_ms",
-    "live_trainer_pids", "contended",
+    "live_trainer_pids", "contended", "config_fingerprint",
 )
 
 # Multi-chip scale-out measurement (ISSUE 8): per-device-count dp-sharded
@@ -214,6 +214,24 @@ def _sentinel_ms(repeats: int = 30):
         tiny(x).block_until_ready()
         times.append(time.perf_counter() - t0)
     return 1e3 * statistics.median(times)
+
+
+def _bench_config_fingerprint():
+    """Identity of the knob set the headline numbers ran under — the
+    DEFAULT resolved tune/space.py configuration (bench measures the
+    hand-tuned defaults; autotune's A/B receipts carry their own
+    per-candidate fingerprints). Stamped on the emission so a bench line
+    and an autotune receipt are comparable by provenance, not by faith."""
+    from howtotrainyourmamlpytorch_tpu.tune.space import (
+        TuneContext,
+        config_fingerprint,
+        resolve,
+    )
+
+    ctx = TuneContext(
+        n_devices=len(jax.devices()), dp=1, mp=1, global_batch=8
+    )
+    return config_fingerprint(resolve({}, ctx))
 
 
 def _windowed_rates(windows, run_window):
@@ -1583,6 +1601,9 @@ def main() -> None:
                 "quiet_sentinel_norm_ms": quiet_norm_ms,
                 "live_trainer_pids": live_trainers,
                 "contended": contended,
+                # Knob-set provenance (tune/space.py): which resolved
+                # configuration these numbers describe.
+                "config_fingerprint": _bench_config_fingerprint(),
             }
     )
     # Key-drift self-report (the judge's stale-key detector reads

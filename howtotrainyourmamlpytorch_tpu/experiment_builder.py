@@ -269,6 +269,7 @@ class ExperimentBuilder:
             # MFU denominator override (--peak_flops; 0/absent = auto from
             # the device kind via telemetry/device.py's per-backend table).
             peak_flops=float(getattr(args, "peak_flops", 0.0) or 0.0) or None,
+            config_fingerprint=self._config_fingerprint(args),
         )
         # Live introspection: the heartbeat (logs/status.json, atomic
         # tmp+rename at the existing forced-read boundaries) carries
@@ -862,6 +863,19 @@ class ExperimentBuilder:
     # ------------------------------------------------------------------
     # Observability (delegated to telemetry/ — see TrainTelemetry)
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _config_fingerprint(args) -> str | None:
+        """12-hex identity of the resolved tuning-knob set (tune/space.py)
+        — stamped on step events, heartbeats, and bench emissions so any
+        measurement is attributable to the exact configuration that ran.
+        Best-effort: a half-built args namespace must not kill a run."""
+        try:
+            from .tune.space import fingerprint_from_args
+
+            return fingerprint_from_args(args)
+        except Exception:  # noqa: BLE001 — provenance, not correctness
+            return None
 
     def _heartbeat_extra(self) -> dict:
         """Builder-owned heartbeat fields (host scalars only — the

@@ -26,6 +26,10 @@ Endpoints::
     POST /admin/promote  {"checkpoint": "<path>"} — safe hot-swap: manifest
                          verify + canary episodes, 409 on rejection (the
                          old state keeps serving)
+    POST /admin/scale    {"pool_size": n} — elastic fleet size (the
+                         autoscaler daemon's actuator): ReplicaPool.resize,
+                         idempotent on the target size; 409 when the
+                         serving tier is a single engine, not a pool
     GET  /healthz        -> 200 {"status": "ok", "ready": true, ...} once
                             warmed; 503 with ``ready: false`` before the
                             engine has ever produced logits; ``degraded``
@@ -312,6 +316,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._post_episode()
         elif self.path == "/admin/promote":
             self._post_promote()
+        elif self.path == "/admin/scale":
+            self._post_scale()
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
@@ -385,6 +391,31 @@ class _Handler(BaseHTTPRequestHandler):
                 409, {"error": str(exc), "reason": exc.reason}
             )
             return
+        except (KeyError, ValueError, TypeError) as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        except Exception as exc:
+            self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._send_json(200, result)
+
+    def _post_scale(self) -> None:
+        """``{"pool_size": n}`` -> ``ReplicaPool.resize(n)``. Only the
+        pool front door scales; a single-engine API answers 409 so an
+        autoscaler pointed at the wrong tier fails loudly, not as a
+        silent no-op."""
+        try:
+            payload = self._read_body()
+            if payload is None:
+                return
+            if not getattr(self.api, "is_replica_pool", False):
+                self._send_json(
+                    409,
+                    {"error": "serving tier is not a replica pool; "
+                              "/admin/scale needs one"},
+                )
+                return
+            result = self.api.resize(int(payload["pool_size"]))
         except (KeyError, ValueError, TypeError) as exc:
             self._send_json(400, {"error": str(exc)})
             return

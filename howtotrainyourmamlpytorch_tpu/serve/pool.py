@@ -515,6 +515,11 @@ class ReplicaPool:
         the successor serving correctly, just colder."""
         assert self.config.tier_root is not None
         with self._lock:
+            # A resize may have shrunk the fleet between the retirement
+            # that queued this pair and now — a vanished successor just
+            # means the arc's history is lost, never an IndexError.
+            if succ_index >= len(self._slots):
+                return
             slot = self._slots[succ_index]
             replica = (
                 slot.replica if slot.state == HEALTHY else None
@@ -535,6 +540,72 @@ class ReplicaPool:
             successor=succ_index,
             entries=adopted,
         )
+
+    # ------------------------------------------------------------------
+    # Elastic fleet size (the autoscaler's actuator)
+    # ------------------------------------------------------------------
+
+    def resize(self, n: int) -> dict:
+        """Grows or shrinks the fleet to ``n`` supervised slots.
+
+        Idempotent by construction — ``resize(pool_size)`` is a no-op —
+        which is what lets the autoscaler daemon resume a journaled
+        decision after a crash by simply re-issuing it: the target size,
+        not a delta, is the journaled fact (``serve/resilience/
+        autoscaler.py``).
+
+        Grow appends fresh RETIRED slots due immediately; the supervisor
+        starts them on its next round (the factory runs on the supervisor
+        thread, never under this lock) and they join the ring when their
+        first health probe passes — with a durable tier + AOT exec cache
+        the warmup is compile-free, so ready-time is milliseconds-scale.
+
+        Shrink retires the HIGHEST-index slots: low indices keep their
+        identity, so ring arcs, ``replica-<i>`` tier directories, and the
+        canary (slot 0) are never reshuffled by a scale-down. Each
+        removed replica's arc moves to its ring successor (with spill
+        rehydration when a durable tier is configured — the same path a
+        death takes), and the replica itself drains through the
+        graveyard, terminated by the supervisor outside the lock."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"pool size must be >= 1, got {n}")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("cannot resize a closed pool")
+            before = len(self._slots)
+            if n == before:
+                return {"pool_size": before, "added": 0, "removed": 0}
+            if n > before:
+                now = time.monotonic()
+                for i in range(before, n):
+                    slot = _Slot(i)
+                    slot.next_restart_at = now  # due immediately
+                    self._slots.append(slot)
+            else:
+                for slot in self._slots[n:]:
+                    if slot.replica is not None:
+                        self._graveyard.append(slot.replica)
+                        slot.replica = None
+                    if slot.index in self._ring:
+                        self._ring.remove(slot.index)
+                        successor = self._ring.successor(slot.index)
+                        if successor is not None and self.config.tier_root:
+                            self._rehydrate_q.append(
+                                (slot.index, int(successor))
+                            )
+                    slot.state = RETIRED
+                del self._slots[n:]
+            after = len(self._slots)
+            self._lock.notify()  # wake the supervisor: starts / graveyard
+        telemetry_events.emit(
+            "pool_resized", before=before, after=after,
+        )
+        return {
+            "pool_size": after,
+            "added": max(0, after - before),
+            "removed": max(0, before - after),
+        }
 
     # ------------------------------------------------------------------
     # Operational surface (ServingAPI-shaped)
@@ -575,7 +646,7 @@ class ReplicaPool:
     ) -> bool:
         """Blocks until ``healthy`` replicas (default: all) pass health
         checks; returns False on timeout."""
-        want = self.config.n_replicas if healthy is None else healthy
+        want = len(self._slots) if healthy is None else healthy
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.healthz()["healthy_replicas"] >= want:
@@ -689,6 +760,8 @@ class ReplicaPool:
             f"{p}_rehydrations_total {m.rehydrations_total.value}",
             f"# TYPE {p}_replica_ready_s gauge",
             f"{p}_replica_ready_s {self._last_ready_s or 0.0:.6f}",
+            f"# TYPE {p}_pool_size gauge",
+            f"{p}_pool_size {health['pool_size']}",
             f"# TYPE {p}_healthy_replicas gauge",
             f"{p}_healthy_replicas {health['healthy_replicas']}",
             f"# TYPE {p}_degraded gauge",

@@ -1,0 +1,483 @@
+"""Journal-backed fleet autoscaler: load-following replica count.
+
+Sibling of the promotion daemon (``promotion.py``) and built from the
+same machinery — the fsync'd JSONL :class:`~.promotion.PromotionJournal`,
+the ``parse_prometheus`` scrape, the HTTP front-door client — because it
+shares the same problem shape: an unattended daemon mutating a live
+serving fleet must survive SIGKILL at any instant without double-driving
+the mutation. Three contracts:
+
+* **declared policy, pure decision** — the scaling policy is data
+  (:class:`AutoscalerPolicy`) and the decision is a pure function
+  (:func:`decide`) over one :class:`Observation` (queue depth, p99,
+  ``degraded`` gauge, healthy count from ``/healthz`` + ``/metrics``,
+  memory watermarks from heartbeat ``status.json``). No hidden state:
+  the same observation always yields the same verdict, which is what
+  makes the chaos proof deterministic.
+* **journal-then-act, resume-by-target** — every decision is journaled
+  (``decided`` row: decision id, from/to size, reason) BEFORE the fleet
+  is touched, then applied through ``ReplicaPool.resize`` (or POST
+  ``/admin/scale``), journaled ``applied``, and finally ``settled`` once
+  the fleet reports healthy at the target size. The journaled fact is
+  the TARGET SIZE, not a delta, and ``resize`` is idempotent on it — so
+  a daemon SIGKILLed between the journal write and the spawn (or between
+  the spawn and the ``applied`` row) resumes by simply re-issuing the
+  same target: no double-spawned replica, no orphan, regardless of which
+  side of the kill the resize landed on. ``resumed`` rows are audit
+  only, never folded into a decision's lifecycle phase.
+* **bounded and vetoed** — fleet size is clamped to
+  ``[min_replicas, max_replicas]``, consecutive decisions are separated
+  by a cooldown, and a scale-up is vetoed while the heartbeat's device
+  memory watermark is beyond ``memory_veto_frac`` of its limit — growing
+  a fleet that is spilling HBM converts a latency problem into an OOM.
+
+Replica re-warm rides the existing machinery for free: new slots start
+through the pool factory (compile-free under the durable tier's AOT
+exec cache), and the ``settled`` phase gates on their health probes —
+a scale-up is not "done" until the new replicas answer warmed.
+
+Faultinject kill points (``utils/faultinject.autoscaler_phase``):
+``KILL_PRE_APPLY=1`` (decided journaled, fleet untouched),
+``KILL_POST_APPLY=2`` (fleet resized, ``applied`` row unwritten),
+``KILL_PRE_SETTLE=3`` (``applied`` journaled, settle unconfirmed).
+CLI wrapper: ``tools/autoscaler_daemon.py``; chaos proof:
+``tools/chaos_train.py --schedule autoscale``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from collections import deque
+
+from ...telemetry import events as telemetry_events
+from ...utils import faultinject
+from .promotion import (
+    HttpTarget,
+    PromotionJournal,
+    PromotionTransportError,
+    parse_prometheus,
+)
+
+#: Journal phase names. ``settled``/``aborted`` are terminal for a
+#: decision id; ``resumed`` is an audit row (never a lifecycle state).
+PHASE_DECIDED = "decided"
+PHASE_APPLIED = "applied"
+PHASE_SETTLED = "settled"
+PHASE_ABORTED = "aborted"
+PHASE_RESUMED = "resumed"
+
+TERMINAL_PHASES = (PHASE_SETTLED, PHASE_ABORTED)
+
+#: Faultinject kill points (``autoscaler_kill_at_phase=<n>``), one per
+#: journal-phase boundary.
+KILL_PRE_APPLY = 1  # ``decided`` journaled, resize not yet issued
+KILL_POST_APPLY = 2  # resize issued, ``applied`` row not yet written
+KILL_PRE_SETTLE = 3  # ``applied`` journaled, settle unconfirmed
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerPolicy:
+    """The declared scaling policy (all thresholds are data — the README
+    quickstart documents each knob; ``tune/space.py`` owns the related
+    serve-batcher knobs)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: Scale up when queue depth per healthy replica exceeds this, or
+    #: front-door p99 exceeds the SLO budget.
+    up_queue_per_replica: float = 4.0
+    up_p99_ms: float = 250.0
+    #: Scale down only when BOTH are comfortably idle (hysteresis: the
+    #: down thresholds sit far below the up thresholds, so the fleet
+    #: never flaps on a steady load).
+    down_queue_per_replica: float = 0.5
+    down_p99_ms: float = 50.0
+    #: Asymmetric steps: grow fast (load spikes are urgent), shrink slow
+    #: (a wrong shrink re-pays replica ready-time under load).
+    step_up: int = 2
+    step_down: int = 1
+    #: Seconds between decisions (settle + signal decorrelation).
+    cooldown_s: float = 5.0
+    #: How long a decision may wait for the fleet to report healthy at
+    #: the target size before the daemon journals it ``settled`` with
+    #: ``healthy=false`` (the next observation re-decides; an unsettled
+    #: fleet is a fact to record, not a reason to wedge the daemon).
+    settle_timeout_s: float = 30.0
+    #: Scale-up veto: heartbeat device memory beyond this fraction of
+    #: its limit means the host is the bottleneck, not the fleet size.
+    memory_veto_frac: float = 0.9
+    #: Consecutive observations a threshold must hold before acting
+    #: (rides out one-sample blips without a full EWMA).
+    confirm_samples: int = 2
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}"
+            )
+        if self.step_up < 1 or self.step_down < 1:
+            raise ValueError("scale steps must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class Observation:
+    """One fused sample of the fleet's load surface."""
+
+    pool_size: int
+    healthy_replicas: int
+    degraded: bool
+    queue_depth: float
+    p99_ms: float
+    memory_frac: float | None = None  # max bytes_in_use/bytes_limit, if known
+    t: float = 0.0
+
+
+def observe(target, heartbeat_path: str | None = None) -> Observation:
+    """Scrapes ``/healthz`` + ``/metrics`` (and optionally a heartbeat
+    ``status.json``) into one :class:`Observation`. Transport failures
+    propagate as ``PromotionTransportError`` — the caller's retry loop
+    owns backoff, not this function."""
+    health = target.healthz()
+    metrics = parse_prometheus(target.metrics_text())
+    # Queue depth lives under the single-engine prefix (the engine owns
+    # the queue); the pool front door may not render it — absent means 0,
+    # which only ever errs toward scaling DOWN, the safe direction.
+    queue_depth = metrics.get("maml_serve_queue_depth", 0.0)
+    p99 = metrics.get(
+        'maml_serve_pool_request_latency_ms{quantile="0.99"}',
+        metrics.get('maml_serve_request_latency_ms{quantile="0.99"}', 0.0),
+    )
+    degraded = bool(
+        metrics.get("maml_serve_pool_degraded", 0.0)
+        or health.get("degraded", False)
+    )
+    memory_frac = _heartbeat_memory_frac(heartbeat_path)
+    return Observation(
+        pool_size=int(health.get("pool_size", 0) or 0),
+        healthy_replicas=int(health.get("healthy_replicas", 0) or 0),
+        degraded=degraded,
+        queue_depth=float(queue_depth),
+        p99_ms=float(p99),
+        memory_frac=memory_frac,
+        t=time.time(),
+    )
+
+
+def _heartbeat_memory_frac(path: str | None) -> float | None:
+    """Max ``bytes_in_use / bytes_limit`` across the heartbeat's device
+    watermarks (``telemetry/runtime.py`` ``status.json`` ``memory`` key).
+    ``None`` when the file, the key, or the limits are absent (CPU
+    backends report no memory stats) — an unknown watermark never
+    vetoes."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    watermarks = payload.get("memory")
+    if not isinstance(watermarks, list):
+        return None
+    fracs = [
+        w["bytes_in_use"] / w["bytes_limit"]
+        for w in watermarks
+        if isinstance(w, dict) and w.get("bytes_limit")
+    ]
+    return max(fracs) if fracs else None
+
+
+def decide(
+    obs: Observation, policy: AutoscalerPolicy
+) -> tuple[int, str] | None:
+    """Pure policy: one observation -> ``(target_size, reason)`` or
+    ``None`` (hold). The caller owns clamping-independent concerns
+    (cooldown, confirmation streaks, journaling)."""
+    size = max(obs.pool_size, 1)
+    per_replica = obs.queue_depth / max(obs.healthy_replicas, 1)
+    if (
+        per_replica > policy.up_queue_per_replica
+        or obs.p99_ms > policy.up_p99_ms
+    ):
+        if obs.memory_frac is not None and (
+            obs.memory_frac >= policy.memory_veto_frac
+        ):
+            return None  # growing a spilling host converts latency to OOM
+        target = min(size + policy.step_up, policy.max_replicas)
+        if target > size:
+            why = (
+                f"queue/replica {per_replica:.2f} > "
+                f"{policy.up_queue_per_replica:g}"
+                if per_replica > policy.up_queue_per_replica
+                else f"p99 {obs.p99_ms:.1f}ms > {policy.up_p99_ms:g}ms"
+            )
+            return target, f"scale_up: {why}"
+    if (
+        per_replica < policy.down_queue_per_replica
+        and obs.p99_ms < policy.down_p99_ms
+        and not obs.degraded
+    ):
+        target = max(size - policy.step_down, policy.min_replicas)
+        if target < size:
+            return target, (
+                f"scale_down: idle (queue/replica {per_replica:.2f}, "
+                f"p99 {obs.p99_ms:.1f}ms)"
+            )
+    return None
+
+
+def replay_scale_journal(rows: list[dict]) -> dict:
+    """Folds journal rows into resume state: per-decision info and last
+    phase, the terminal set, and the in-flight decision (newest decision
+    id whose last phase is non-terminal). ``resumed`` rows are audit
+    only — folding one into ``last_phase`` would make a crash AFTER a
+    resume re-drive the decision from scratch."""
+    info: dict[str, dict] = {}
+    last_phase: dict[str, str] = {}
+    order: list[str] = []
+    for row in rows:
+        did = row.get("decision_id")
+        if not did:
+            continue
+        if row["phase"] == PHASE_RESUMED:
+            continue
+        entry = info.setdefault(did, {"decision_id": did})
+        for key in ("from_size", "to_size", "reason"):
+            if row.get(key) is not None:
+                entry[key] = row[key]
+        if did not in order:
+            order.append(did)
+        last_phase[did] = row["phase"]
+    terminal = {d for d, p in last_phase.items() if p in TERMINAL_PHASES}
+    inflight = None
+    for did in reversed(order):
+        if did not in terminal:
+            inflight = dict(info[did])
+            inflight["last_phase"] = last_phase[did]
+            break
+    return {
+        "info": info,
+        "last_phase": last_phase,
+        "terminal": terminal,
+        "inflight": inflight,
+    }
+
+
+class HttpScaleTarget(HttpTarget):
+    """Front-door client with the scale verb: POST ``/admin/scale``.
+    In-process targets (a ``ReplicaPool``) are used directly — they
+    already quack ``resize``/``healthz``/``metrics_text``."""
+
+    def resize(self, n: int) -> dict:
+        try:
+            return json.loads(
+                self._fetch("/admin/scale", {"pool_size": int(n)})
+            )
+        except PromotionTransportError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — normalize transport
+            raise PromotionTransportError(f"scale failed: {exc}") from exc
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Daemon wiring (policy is separate — :class:`AutoscalerPolicy`)."""
+
+    journal_path: str
+    poll_interval_s: float = 1.0
+    heartbeat_path: str | None = None
+
+
+class AutoscalerDaemon:
+    """Single-threaded decide→journal→apply→settle loop over one target.
+
+    No owned threads (the promotion daemon's SLO watch needs one; a
+    scaler does not — ``run`` is the loop and the caller owns the
+    process). ``run_once`` is the unit the chaos schedule and the
+    faultinject tests drive directly."""
+
+    def __init__(
+        self,
+        target,
+        config: AutoscalerConfig,
+        policy: AutoscalerPolicy | None = None,
+    ):
+        self.target = target
+        self.config = config
+        self.policy = policy or AutoscalerPolicy()
+        self.journal = PromotionJournal(config.journal_path)
+        self._decisions = 0
+        self._last_decision_t = 0.0
+        self._streak: deque[int] = deque(
+            maxlen=max(1, self.policy.confirm_samples)
+        )
+        self._resume_pending = True
+
+    # -- resume -------------------------------------------------------
+
+    def _resume_inflight(self) -> dict | None:
+        """Replays the journal; re-drives the newest non-terminal
+        decision by re-issuing its TARGET size (idempotent — see module
+        docstring), then settles it. Returns the settled row or None."""
+        state = replay_scale_journal(PromotionJournal.load(self.journal.path))
+        # Future decision ids must not collide with journaled ones.
+        self._decisions = len(state["info"])
+        inflight = state["inflight"]
+        if inflight is None:
+            return None
+        to_size = int(inflight["to_size"])
+        try:
+            health = self.target.healthz()
+            observed = int(health.get("pool_size", 0) or 0)
+        except PromotionTransportError:
+            return None  # fleet unreachable: retry on the next run_once
+        self.journal.append(
+            PHASE_RESUMED,
+            decision_id=inflight["decision_id"],
+            from_phase=inflight["last_phase"],
+            observed_pool_size=observed,
+        )
+        row = self._apply_and_settle(
+            inflight["decision_id"], to_size, resumed=True,
+            already_applied=inflight["last_phase"] == PHASE_APPLIED,
+        )
+        return row
+
+    # -- the loop unit ------------------------------------------------
+
+    def run_once(self) -> dict | None:
+        """One observation -> at most one journaled scale decision.
+        Returns the terminal journal row of any decision driven (freshly
+        decided OR resumed), else None."""
+        if self._resume_pending:
+            self._resume_pending = False
+            resumed = self._resume_inflight()
+            if resumed is not None:
+                self._last_decision_t = time.monotonic()
+                return resumed
+        try:
+            obs = observe(self.target, self.config.heartbeat_path)
+        except PromotionTransportError:
+            return None  # unreachable fleet: observe again next tick
+        verdict = decide(obs, self.policy)
+        if verdict is None:
+            self._streak.clear()
+            return None
+        target_size, reason = verdict
+        self._streak.append(target_size)
+        if (
+            len(self._streak) < self.policy.confirm_samples
+            or len(set(self._streak)) != 1
+        ):
+            return None  # unconfirmed blip
+        if (
+            time.monotonic() - self._last_decision_t
+            < self.policy.cooldown_s
+        ):
+            return None
+        self._streak.clear()
+        self._decisions += 1
+        decision_id = f"scale-{self._decisions:04d}"
+        self.journal.append(
+            PHASE_DECIDED,
+            decision_id=decision_id,
+            from_size=obs.pool_size,
+            to_size=target_size,
+            reason=reason,
+            queue_depth=obs.queue_depth,
+            p99_ms=obs.p99_ms,
+        )
+        telemetry_events.emit(
+            "autoscale_decided",
+            decision_id=decision_id,
+            from_size=obs.pool_size,
+            to_size=target_size,
+            reason=reason,
+        )
+        self._last_decision_t = time.monotonic()
+        return self._apply_and_settle(decision_id, target_size)
+
+    def _apply_and_settle(
+        self,
+        decision_id: str,
+        to_size: int,
+        *,
+        resumed: bool = False,
+        already_applied: bool = False,
+    ) -> dict:
+        """decided -> applied -> settled, faultinject hooks at each
+        boundary. ``already_applied`` skips the resize re-issue's journal
+        row only — the resize itself is ALWAYS re-issued (idempotent on
+        the target size), because "applied journaled" does not prove the
+        pool still holds that size after its own crash/restart."""
+        faultinject.autoscaler_phase(KILL_PRE_APPLY)
+        try:
+            self.target.resize(to_size)
+        except (PromotionTransportError, RuntimeError, ValueError) as exc:
+            row = self.journal.append(
+                PHASE_ABORTED,
+                decision_id=decision_id,
+                to_size=to_size,
+                error=str(exc),
+                resumed=resumed,
+            )
+            telemetry_events.emit(
+                "autoscale_aborted", decision_id=decision_id, error=str(exc)
+            )
+            return row
+        faultinject.autoscaler_phase(KILL_POST_APPLY)
+        if not already_applied:
+            self.journal.append(
+                PHASE_APPLIED,
+                decision_id=decision_id,
+                to_size=to_size,
+                resumed=resumed,
+            )
+        faultinject.autoscaler_phase(KILL_PRE_SETTLE)
+        healthy = self._await_settle(to_size)
+        row = self.journal.append(
+            PHASE_SETTLED,
+            decision_id=decision_id,
+            to_size=to_size,
+            healthy=healthy,
+            resumed=resumed,
+        )
+        telemetry_events.emit(
+            "autoscale_settled",
+            decision_id=decision_id,
+            to_size=to_size,
+            healthy=healthy,
+            resumed=resumed,
+        )
+        return row
+
+    def _await_settle(self, to_size: int) -> bool:
+        """Polls ``/healthz`` until ``healthy_replicas >= to_size`` (the
+        re-warm gate: pool probes pass only once a replica answers
+        warmed) or the settle budget lapses."""
+        deadline = time.monotonic() + self.policy.settle_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                health = self.target.healthz()
+            except PromotionTransportError:
+                time.sleep(self.config.poll_interval_s)
+                continue
+            if int(health.get("healthy_replicas", 0) or 0) >= to_size:
+                return True
+            time.sleep(min(0.1, self.config.poll_interval_s))
+        return False
+
+    def run(self, stop) -> None:
+        """Drives ``run_once`` every ``poll_interval_s`` until ``stop``
+        (a ``threading.Event``) is set. The CLI wrapper owns signal
+        handling; tests own the loop by calling ``run_once`` directly."""
+        while not stop.is_set():
+            self.run_once()
+            stop.wait(self.config.poll_interval_s)

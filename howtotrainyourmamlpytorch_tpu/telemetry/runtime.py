@@ -73,9 +73,18 @@ class TrainTelemetry:
         process_count: int = 1,
         trace_id: str | None = None,
         peak_flops: float | None = None,
+        config_fingerprint: str | None = None,
     ):
         self.enabled = bool(enabled)
         self.logs_dir = logs_dir
+        # Resolved-knob identity (tune/space.py config_fingerprint):
+        # stamped on every event via context and on every heartbeat, so a
+        # telemetry stream / ledger row / bench emission from THIS run is
+        # attributable to the exact tuning configuration that produced it
+        # — the provenance link the autotuner's A/B receipts close over.
+        self.config_fingerprint = (
+            str(config_fingerprint) if config_fingerprint else None
+        )
         # Run-scoped trace id (cross-rank correlation): an explicit value
         # wins, then the dispatcher-exported env (every rank of a fleet
         # phase inherits the SAME id), then a fresh one. Stamped on every
@@ -182,6 +191,7 @@ class TrainTelemetry:
             trace_id=self.trace_id,
             process_index=self.process_index,
             process_count=self.process_count,
+            config_fingerprint=self.config_fingerprint,
         )
         self.events.emit("run_start", pid=os.getpid(),
                          process_index=self.process_index,
@@ -360,6 +370,8 @@ class TrainTelemetry:
             "epoch": self._epoch,
             "anomalies": self.anomaly.reports,
         }
+        if self.config_fingerprint is not None:
+            payload["config_fingerprint"] = self.config_fingerprint
         steps = self.anomaly.window_stats("step_time")
         if steps is not None and steps["sum_s"] > 0:
             rate = steps["count"] / steps["sum_s"]

@@ -1,0 +1,20 @@
+"""Self-driving resource plane (ISSUE 20).
+
+``tune/space.py`` declares every performance knob as DATA — legal
+values, divisibility guards, and which bench key each knob moves — so
+the search space is introspectable and lint-checkable instead of
+scattered across argparse. ``tune/autotuner.py`` closes the loop: it
+reads the ProgramLedger's roofline position and the BENCH_* trajectory,
+ranks candidate single-knob moves, drives short A/B probes under
+bench's contention-sentinel protocol, and hands the verdict to
+``tools/bench_judge.py`` mechanically.
+"""
+
+from .space import (  # noqa: F401
+    Knob,
+    TuneContext,
+    SPACE,
+    config_fingerprint,
+    fingerprint_from_args,
+    resolve,
+)

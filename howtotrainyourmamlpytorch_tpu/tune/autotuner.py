@@ -1,0 +1,452 @@
+"""Ledger-guided autotuner: roofline position -> ranked A/B probes ->
+mechanical keep/revert via bench_judge.
+
+Zero human choices, end to end: the knob space is declared data
+(``tune/space.py``), the roofline regime is read from the ProgramLedger
+(arithmetic intensity vs the ``PEAK_FLOPS_BY_KIND`` peak over an HBM
+ridge), every probe runs under bench's contention-sentinel protocol
+(flagged probes retried then DISCARDED — a poisoned number is never
+judged), and the verdict is handed to ``tools/bench_judge.judge``
+mechanically: the winning lever's gate is appended to
+``tools/bench_gates.json`` with provenance ``source: autotune:<run_id>``
+only when the judge says ``keep``. A human never picks a number, and a
+future regression of the kept lever still turns tier-1 red through the
+ordinary judge path.
+
+The probe is deliberately tiny (2-stage 4-filter first-order MAML on
+28x28 synthetic episodes): the tuned knobs move DISPATCH and LAYOUT
+costs, which the tiny program exposes undiluted, and a probe must be
+cheap enough to run on a quiet host between real work. Measured values
+land in ``AUTOTUNE_<run_id>_r0*.json`` wrappers (the BENCH_* trajectory
+layout), so the receipts replay through the same judge.
+
+Measurement and sentinel functions are injectable (``measure_fn``/
+``sentinel_fn``) so the decision machinery is testable without a JAX
+probe; CLI: ``tools/autotune.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import statistics
+import time
+
+from .space import SPACE, TuneContext, config_fingerprint, resolve
+
+#: The probe's judged bench key and the baseline key its gate references.
+PROBE_KEY = "autotune_probe_meta_iters_per_s"
+BASELINE_KEY = "autotune_baseline_meta_iters_per_s"
+
+#: HBM bandwidth (bytes/s) per device kind for the roofline ridge —
+#: conservative public figures, same keying as
+#: ``telemetry/device.PEAK_FLOPS_BY_KIND``. The ridge (peak FLOPs / BW)
+#: splits memory-bound from compute-bound programs; a kind missing here
+#: falls back to the dispatch regime, which is also the honest CPU
+#: answer (no cost analysis, dispatch overhead dominates tiny programs).
+HBM_BW_BY_KIND = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbeSpec:
+    """The tiny A/B workload (identical for baseline and candidates —
+    only the knob under test differs)."""
+
+    batch_size: int = 8
+    num_classes: int = 5
+    shots: int = 1
+    num_stages: int = 2
+    num_filters: int = 4
+    image_size: int = 28
+    inner_steps: int = 2
+    #: Meta-iterations one timing window aims for (rounded to whole
+    #: dispatches of the candidate's K).
+    window_iters: int = 50
+    windows: int = 3
+    #: Sentinel retries before a contended probe is discarded.
+    contention_retries: int = 2
+
+
+def classify_regime(
+    arithmetic_intensity: float | None,
+    device_kind: str,
+    peak_flops: float | None,
+) -> tuple[str, str]:
+    """Roofline position -> knob regime (``dispatch``/``memory``/
+    ``compute``) + a human reason. Intensity below the ridge means the
+    program is HBM-bound; above it, FLOPs-bound; unknown (no cost
+    analysis — CPU backends) means per-dispatch overhead is the only
+    measurable lever."""
+    bw = HBM_BW_BY_KIND.get(device_kind)
+    if arithmetic_intensity is None or not peak_flops or not bw:
+        return "dispatch", (
+            f"no roofline position for {device_kind!r} (no cost analysis "
+            "or no bandwidth table entry): dispatch overhead is the "
+            "measurable lever"
+        )
+    ridge = peak_flops / bw
+    if arithmetic_intensity < ridge:
+        return "memory", (
+            f"intensity {arithmetic_intensity:.1f} FLOP/B below the "
+            f"{device_kind} ridge {ridge:.1f}: HBM-bound"
+        )
+    return "compute", (
+        f"intensity {arithmetic_intensity:.1f} FLOP/B above the "
+        f"{device_kind} ridge {ridge:.1f}: FLOPs-bound"
+    )
+
+
+def rank_candidates(
+    regime: str, ctx: TuneContext, max_candidates: int = 6
+) -> list[tuple[str, object]]:
+    """Single-knob candidates ``(knob_name, value)``, regime-matching
+    knobs first (stable within a knob: declared candidate order), capped
+    at ``max_candidates``. Only probe-appliable train knobs are ranked —
+    a knob the probe cannot apply would judge noise."""
+    ranked: list[tuple[str, object]] = []
+    knobs = sorted(
+        (k for k in SPACE.values()
+         if k.plane == "train" and k.name in PROBE_APPLIERS),
+        key=lambda k: (k.regime != regime, k.name),
+    )
+    for knob in knobs:
+        for value in knob.legal_candidates(ctx):
+            ranked.append((knob.name, value))
+    return ranked[:max_candidates]
+
+
+# ---------------------------------------------------------------------------
+# The default probe (JAX) — injectable for tests
+# ---------------------------------------------------------------------------
+
+
+def _probe_batch(spec: ProbeSpec, rng):
+    import numpy as np
+
+    n = spec.num_classes * spec.shots
+    img = (1, spec.image_size, spec.image_size)
+    xs = rng.rand(spec.batch_size, n, *img).astype(np.float32)
+    xt = rng.rand(spec.batch_size, n, *img).astype(np.float32)
+    ys = np.tile(
+        np.repeat(np.arange(spec.num_classes, dtype=np.int32), spec.shots),
+        (spec.batch_size, 1),
+    )
+    return xs, xt, ys, ys.copy()
+
+
+def _probe_config(overrides: dict, spec: ProbeSpec):
+    from ..models import BackboneConfig, MAMLConfig
+
+    backbone = BackboneConfig(
+        num_stages=spec.num_stages,
+        num_filters=spec.num_filters,
+        per_step_bn_statistics=True,
+        num_steps=spec.inner_steps,
+        num_classes=spec.num_classes,
+        image_channels=1,
+        image_height=spec.image_size,
+        image_width=spec.image_size,
+        lane_pad_channels=bool(overrides.get("lane_pad_channels", False)),
+    )
+    return MAMLConfig(
+        backbone=backbone,
+        number_of_training_steps_per_iter=spec.inner_steps,
+        number_of_evaluation_steps_per_iter=spec.inner_steps,
+        task_learning_rate=0.1,
+        learnable_per_layer_per_step_inner_loop_learning_rate=True,
+        second_order=False,
+        use_multi_step_loss_optimization=False,
+        task_chunk=int(overrides.get("task_chunk", 0)),
+    )
+
+
+#: Knob name -> how the default probe applies it. Membership IS the
+#: "probeable on this host" predicate ``rank_candidates`` filters on;
+#: the values document the seam each knob rides.
+PROBE_APPLIERS = {
+    "iters_per_dispatch": "K batches per run_train_iters dispatch",
+    "task_chunk": "MAMLConfig.task_chunk",
+    "lane_pad_channels": "BackboneConfig.lane_pad_channels",
+}
+
+
+def default_measure(overrides: dict, spec: ProbeSpec) -> float:
+    """Builds the tiny learner with ``overrides`` applied and returns the
+    median-window meta-iters/s (same windowed-median shape as bench's
+    ``_windowed_rates`` — robust to a transient dip, no max-selection
+    bias)."""
+    import jax
+    import numpy as np
+
+    from ..models import MAMLFewShotLearner
+
+    cfg = _probe_config(overrides, spec)
+    k = int(overrides.get("iters_per_dispatch", 1))
+    learner = MAMLFewShotLearner(cfg)
+    state = learner.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    batches = [_probe_batch(spec, rng) for _ in range(k)]
+    state, _ = learner.run_train_iters(state, batches, epoch=0)  # compile
+    jax.block_until_ready(state.theta)
+    per_window = max(1, -(-spec.window_iters // k))
+    rates = []
+    for _ in range(spec.windows):
+        t0 = time.perf_counter()
+        for _ in range(per_window):
+            state, _ = learner.run_train_iters(state, batches, epoch=0)
+        jax.block_until_ready(state.theta)
+        rates.append(per_window * k / (time.perf_counter() - t0))
+    return statistics.median(rates)
+
+
+#: Run-local sentinel floor, established once per process by
+#: ``default_sentinel`` (min of a few startup readings). An A/B probe
+#: needs WITHIN-RUN consistency — both sides measured under equal load —
+#: not the cross-run comparability the persistent BENCH quiet norms
+#: provide, and those norms are recorded for other hosts. The env
+#: override (``BENCH_QUIET_SENTINEL_MS``) still wins when set, and the
+#: live-trainer /proc scan — the direct signal — is always honored.
+_run_floor_ms: float | None = None
+
+
+def default_sentinel() -> dict:
+    """One contention reading via bench's sentinel protocol (lazy import:
+    ``bench`` lives at the repo root, on ``sys.path`` for every tools/
+    CLI), judged against the run-local floor (see ``_run_floor_ms``).
+    Returns ``{"contended": bool, ...signals}``; an import failure
+    reports honestly unknown (``contended: False, sentinel_ms: None``) —
+    the CLI records the gap rather than inventing a quiet reading."""
+    global _run_floor_ms
+    try:
+        import bench
+    except ImportError:
+        return {"contended": False, "sentinel_ms": None,
+                "reason": "bench module unavailable"}
+
+    env = os.environ.get("BENCH_QUIET_SENTINEL_MS")
+    if _run_floor_ms is None:
+        if env:
+            try:
+                _run_floor_ms = float(env)
+            except ValueError:
+                _run_floor_ms = None
+        if _run_floor_ms is None:
+            _run_floor_ms = min(
+                bench._sentinel_ms(repeats=10) for _ in range(3)
+            )
+    ms = bench._sentinel_ms(repeats=10)
+    trainers = bench._live_trainer_pids()
+    contended = bool(trainers) or (
+        ms > bench.SENTINEL_CONTENTION_FACTOR * _run_floor_ms
+    )
+    return {
+        "contended": contended,
+        "sentinel_ms": ms,
+        "floor_ms": _run_floor_ms,
+        "live_trainers": trainers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The run
+# ---------------------------------------------------------------------------
+
+
+def _measure_clean(
+    overrides: dict, spec: ProbeSpec, measure_fn, sentinel_fn
+) -> tuple[float | None, list[dict]]:
+    """One sentinel-bracketed measurement, retried while flagged. Returns
+    ``(value, sentinel_log)`` — value ``None`` when every attempt was
+    contended (the probe is DISCARDED, never judged)."""
+    log: list[dict] = []
+    for _attempt in range(spec.contention_retries + 1):
+        before = sentinel_fn()
+        value = measure_fn(overrides, spec)
+        after = sentinel_fn()
+        flagged = bool(before["contended"] or after["contended"])
+        log.append({"before": before, "after": after, "flagged": flagged})
+        if not flagged:
+            return value, log
+    return None, log
+
+
+def autotune_run(
+    *,
+    run_id: str,
+    ctx: TuneContext | None = None,
+    spec: ProbeSpec | None = None,
+    min_gain: float = 0.05,
+    max_candidates: int = 6,
+    device_kind: str | None = None,
+    peak_flops: float | None = None,
+    arithmetic_intensity: float | None = None,
+    measure_fn=default_measure,
+    sentinel_fn=default_sentinel,
+    judge_fn=None,
+) -> dict:
+    """The full loop: classify -> rank -> probe (sentinel-clean) ->
+    judge -> verdict document.
+
+    The caller (``tools/autotune.py``) owns filesystem side effects
+    (emission wrappers, the gates-file append); this function returns the
+    verdict document only, so tests can drive it hermetically with
+    injected ``measure_fn``/``sentinel_fn``. ``judge_fn`` defaults to
+    ``tools.bench_judge.judge`` (lazy import)."""
+    ctx = ctx or TuneContext()
+    spec = spec or ProbeSpec()
+    if judge_fn is None:
+        from tools.bench_judge import judge as judge_fn  # noqa: PLC0415
+
+    regime, regime_reason = classify_regime(
+        arithmetic_intensity, device_kind or "cpu", peak_flops
+    )
+    candidates = rank_candidates(regime, ctx, max_candidates)
+
+    baseline, baseline_log = _measure_clean({}, spec, measure_fn, sentinel_fn)
+    result = {
+        "run_id": run_id,
+        "regime": regime,
+        "regime_reason": regime_reason,
+        "ranked_candidates": [
+            {"knob": name, "value": value} for name, value in candidates
+        ],
+        "baseline": baseline,
+        "baseline_sentinel": baseline_log[-1] if baseline_log else None,
+        "probes": [],
+        "winner": None,
+        "emissions": None,
+    }
+    if baseline is None:
+        result["error"] = (
+            "baseline probe contended on every attempt — nothing judged"
+        )
+        return result
+
+    best = None  # (value, knob_name, knob_value, fingerprint)
+    for name, value in candidates:
+        overrides = {name: value}
+        measured, _log = _measure_clean(
+            overrides, spec, measure_fn, sentinel_fn
+        )
+        probe_row = {
+            "knob": name,
+            "value": value,
+            "measured": measured,
+            "discarded": measured is None,
+        }
+        result["probes"].append(probe_row)
+        if measured is None:
+            continue
+        if best is None or measured > best[0]:
+            fp = config_fingerprint(resolve(overrides, ctx))
+            best = (measured, name, value, fp)
+
+    if best is None:
+        result["error"] = "every candidate probe contended — nothing judged"
+        return result
+
+    measured, knob_name, knob_value, fingerprint = best
+    knob = SPACE[knob_name]
+    lever = f"{knob.flag}={knob_value}"
+    gate_expr = f"this > {1.0 + min_gain:g} * {BASELINE_KEY}"
+    gates_doc = {
+        "schema": 1,
+        "gates": {
+            PROBE_KEY: {
+                "direction": "higher",
+                "gate": gate_expr,
+                "lever": lever,
+                "source": f"autotune:{run_id}",
+            },
+        },
+        "ungated_ok": [
+            BASELINE_KEY, "contended", "config_fingerprint",
+            "autotune_knob", "autotune_value",
+        ],
+    }
+    baseline_fp = config_fingerprint(resolve({}, ctx))
+    runs = [
+        {
+            "name": f"AUTOTUNE_{run_id}_r01.json",
+            "n": 1,
+            "parsed": {
+                PROBE_KEY: baseline,
+                BASELINE_KEY: baseline,
+                "contended": False,
+                "config_fingerprint": baseline_fp,
+            },
+            "contended": False,
+        },
+        {
+            "name": f"AUTOTUNE_{run_id}_r02.json",
+            "n": 2,
+            "parsed": {
+                PROBE_KEY: measured,
+                BASELINE_KEY: baseline,
+                "autotune_knob": knob_name,
+                "autotune_value": knob_value,
+                "contended": False,
+                "config_fingerprint": fingerprint,
+            },
+            "contended": False,
+        },
+    ]
+    judged = judge_fn(gates_doc, runs)
+    verdict = judged["verdicts"][PROBE_KEY]["verdict"]
+    result["emissions"] = [dict(run) for run in runs]
+    result["judge"] = {
+        "verdict": verdict,
+        "reason": judged["verdicts"][PROBE_KEY]["reason"],
+        "gate": gate_expr,
+    }
+    result["winner"] = (
+        {
+            "knob": knob_name,
+            "value": knob_value,
+            "lever": lever,
+            "measured": measured,
+            "baseline": baseline,
+            "gain": measured / baseline - 1.0,
+            "config_fingerprint": fingerprint,
+            "gate_entry": {
+                **gates_doc["gates"][PROBE_KEY],
+                "note": (
+                    f"autotuned on {device_kind or 'cpu'}: {lever} "
+                    f"{baseline:.2f} -> {measured:.2f} meta-iters/s "
+                    f"({(measured / baseline - 1.0) * 100:.0f}% gain, "
+                    f"sentinel-clean)"
+                ),
+            },
+        }
+        if verdict == "keep"
+        else None
+    )
+    return result
+
+
+def append_gate(
+    gates_path: str, key: str, entry: dict, ungated_extra=()
+) -> None:
+    """Appends/replaces one gate in ``tools/bench_gates.json`` (atomic
+    tmp+rename — a killed autotuner never leaves a torn gates file) and
+    records any referenced helper keys in ``ungated_ok``."""
+    with open(gates_path) as f:
+        doc = json.load(f)
+    doc["gates"][key] = entry
+    ungated = list(doc.get("ungated_ok", []))
+    for name in ungated_extra:
+        if name not in ungated:
+            ungated.append(name)
+    doc["ungated_ok"] = ungated
+    tmp = gates_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, gates_path)

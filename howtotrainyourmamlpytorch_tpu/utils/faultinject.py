@@ -78,6 +78,11 @@ Control-plane faults (the continuous train→serve loop,
   1 = journaled/pre-verify, 2 = verified/pre-publish, 3 = published/
   pre-journal, 4 = promoted-journaled/pre-SLO-resolution), proving
   crash-safe journal replay at every boundary;
+* ``autoscaler_kill_at_phase`` — same for the autoscaler daemon
+  (``serve/resilience/autoscaler.py`` phase constants: 1 = decided/
+  pre-apply, 2 = applied/pre-journal, 3 = applied-journaled/
+  pre-settle), proving a scale decision resumes exactly-once with no
+  double-spawned replica;
 * ``regress_after_promote`` — arm ``nan_next_logits=K`` the moment the
   NEXT promotion publishes (``promotion_applied`` hook in the pool/API
   promote paths): the freshly promoted state immediately serves K
@@ -145,6 +150,7 @@ class FaultPlan:
     corrupt_candidate_at: int | None = None
     kill_trainer_mid_publish: int = 0
     daemon_kill_at_phase: int | None = None
+    autoscaler_kill_at_phase: int | None = None
     regress_after_promote: int = 0
     torn_spill_write_at: int | None = None
     corrupt_cache_entry_at: int | None = None
@@ -489,6 +495,21 @@ def daemon_phase(phase: int) -> None:
         return
     plan.daemon_kill_at_phase = None
     events.append(f"daemon-kill:phase{phase}")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def autoscaler_phase(phase: int) -> None:
+    """Called by the autoscaler daemon at each journal-phase boundary;
+    SIGKILLs the process when ``autoscaler_kill_at_phase`` names this
+    phase (one-shot) — proves a scale decision journaled-then-acted
+    resumes exactly-once (no double-spawn, no orphaned replica)."""
+    plan = _active()
+    if plan is None or plan.autoscaler_kill_at_phase is None:
+        return
+    if int(plan.autoscaler_kill_at_phase) != int(phase):
+        return
+    plan.autoscaler_kill_at_phase = None
+    events.append(f"autoscaler-kill:phase{phase}")
     os.kill(os.getpid(), signal.SIGKILL)
 
 

@@ -1,0 +1,428 @@
+"""Journal-backed fleet autoscaler (ISSUE 20): pure-policy decisions,
+journal replay, and SIGKILL-at-every-boundary exactly-once resume.
+
+Everything here is deterministic and in-process: the daemon is driven
+against a stub fleet (healthz/metrics_text/resize) so decide semantics,
+confirm streaks, cooldown, journal replay and kill-boundary resume are
+provable without subprocess nondeterminism; daemon SIGKILLs are
+simulated by aborting the pipeline at the exact
+``faultinject.autoscaler_phase`` boundaries and rebuilding the daemon
+over the same journal — the artifact state a real SIGKILL leaves. The
+real-process topology (daemon CLI killed with SIGKILL under live
+traffic) is proven by the chaos harness
+(``tools/chaos_train.py --schedule autoscale``)."""
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.serve.resilience import (
+    autoscaler as asc,
+)
+from howtotrainyourmamlpytorch_tpu.serve.resilience.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerDaemon,
+    AutoscalerPolicy,
+    Observation,
+    decide,
+    observe,
+    replay_scale_journal,
+)
+from howtotrainyourmamlpytorch_tpu.serve.resilience.promotion import (
+    PromotionJournal,
+)
+
+
+class StubScaleTarget:
+    """A fleet front door as the autoscaler sees one: health, metrics,
+    and an idempotent ``resize``. ``resize_calls`` records every issued
+    target (re-issues are EXPECTED on resume — the exactly-once claim is
+    about journal lifecycle and final size, not call counts)."""
+
+    def __init__(self, size=1, queue=0.0, p99=10.0, degraded=False):
+        self.size = size
+        self.queue = queue
+        self.p99 = p99
+        self.degraded = degraded
+        self.resize_calls: list[int] = []
+
+    def healthz(self):
+        return {
+            "pool_size": self.size,
+            "healthy_replicas": self.size,
+            "degraded": self.degraded,
+            "ready": self.size > 0,
+        }
+
+    def metrics_text(self):
+        return "\n".join([
+            f"maml_serve_pool_degraded {1.0 if self.degraded else 0.0}",
+            'maml_serve_pool_request_latency_ms{quantile="0.99"} '
+            f"{self.p99}",
+            f"maml_serve_queue_depth {self.queue}",
+        ])
+
+    def resize(self, n):
+        self.resize_calls.append(int(n))
+        self.size = int(n)
+        return {"pool_size": self.size}
+
+
+def make_daemon(tmp_path, target, **policy_kw):
+    defaults = dict(
+        max_replicas=4, cooldown_s=0.0, confirm_samples=1,
+        settle_timeout_s=2.0,
+    )
+    defaults.update(policy_kw)
+    return AutoscalerDaemon(
+        target,
+        AutoscalerConfig(
+            journal_path=str(tmp_path / "autoscale.jsonl"),
+            poll_interval_s=0.01,
+        ),
+        AutoscalerPolicy(**defaults),
+    )
+
+
+def obs(**kw):
+    defaults = dict(
+        pool_size=2, healthy_replicas=2, degraded=False,
+        queue_depth=0.0, p99_ms=100.0,
+    )
+    defaults.update(kw)
+    return Observation(**defaults)
+
+
+POLICY = AutoscalerPolicy(max_replicas=8)
+
+
+# ---------------------------------------------------------------------------
+# decide(): pure policy
+# ---------------------------------------------------------------------------
+
+
+def test_decide_scale_up_on_queue_per_replica():
+    verdict = decide(obs(queue_depth=10.0), POLICY)  # 5.0/replica > 4.0
+    assert verdict is not None
+    target, reason = verdict
+    assert target == 4  # step_up 2
+    assert reason.startswith("scale_up")
+    assert "queue/replica" in reason
+
+
+def test_decide_scale_up_on_p99():
+    target, reason = decide(obs(p99_ms=900.0), POLICY)
+    assert target == 4
+    assert "p99" in reason
+
+
+def test_decide_memory_veto_blocks_scale_up():
+    assert decide(obs(p99_ms=900.0, memory_frac=0.95), POLICY) is None
+    # Below the veto line the same observation scales up.
+    assert decide(obs(p99_ms=900.0, memory_frac=0.5), POLICY) is not None
+
+
+def test_decide_hysteresis_holds_between_thresholds():
+    # p99 between down (50) and up (250): neither direction moves.
+    assert decide(obs(p99_ms=100.0), POLICY) is None
+
+
+def test_decide_scale_down_when_idle():
+    target, reason = decide(obs(pool_size=4, healthy_replicas=4,
+                                p99_ms=10.0), POLICY)
+    assert target == 3  # step_down 1
+    assert reason.startswith("scale_down")
+
+
+def test_decide_scale_down_blocked_while_degraded():
+    assert decide(
+        obs(pool_size=4, healthy_replicas=3, p99_ms=10.0, degraded=True),
+        POLICY,
+    ) is None
+
+
+def test_decide_clamped_at_bounds():
+    assert decide(obs(pool_size=8, healthy_replicas=8, p99_ms=900.0),
+                  POLICY) is None  # already at max
+    assert decide(obs(pool_size=1, healthy_replicas=1, p99_ms=10.0),
+                  POLICY) is None  # already at min
+
+
+# ---------------------------------------------------------------------------
+# observe(): metrics fusion
+# ---------------------------------------------------------------------------
+
+
+def test_observe_fuses_health_and_metrics():
+    target = StubScaleTarget(size=3, queue=6.0, p99=123.0)
+    o = observe(target)
+    assert o.pool_size == 3
+    assert o.healthy_replicas == 3
+    assert o.queue_depth == 6.0
+    assert o.p99_ms == 123.0
+    assert o.degraded is False
+    assert o.memory_frac is None  # no heartbeat: never vetoes
+
+
+def test_observe_missing_queue_reads_zero():
+    """Pool front doors may not render the engine queue gauge; absent
+    must read 0 (errs toward scale-down, the safe direction)."""
+
+    class NoQueue(StubScaleTarget):
+        def metrics_text(self):
+            return ('maml_serve_pool_request_latency_ms{quantile="0.99"} '
+                    f"{self.p99}")
+
+    assert observe(NoQueue(size=2, p99=50.0)).queue_depth == 0.0
+
+
+def test_observe_falls_back_to_engine_latency_prefix():
+    class EngineOnly(StubScaleTarget):
+        def metrics_text(self):
+            return ('maml_serve_request_latency_ms{quantile="0.99"} '
+                    f"{self.p99}")
+
+    assert observe(EngineOnly(p99=77.0)).p99_ms == 77.0
+
+
+# ---------------------------------------------------------------------------
+# replay_scale_journal()
+# ---------------------------------------------------------------------------
+
+
+def test_replay_ignores_resumed_rows_for_phase():
+    """A ``resumed`` audit row must not become a decision's last phase:
+    a second crash right after a resume would otherwise look resolved."""
+    rows = [
+        {"t": 1.0, "phase": "decided", "decision_id": "scale-0001",
+         "from_size": 1, "to_size": 3, "reason": "scale_up: test"},
+        {"t": 2.0, "phase": "resumed", "decision_id": "scale-0001",
+         "from_phase": "decided"},
+    ]
+    state = replay_scale_journal(rows)
+    assert state["inflight"]["last_phase"] == "decided"
+    assert state["inflight"]["to_size"] == 3
+
+
+def test_replay_terminal_settled_and_newest_inflight():
+    rows = [
+        {"t": 1.0, "phase": "decided", "decision_id": "scale-0001",
+         "from_size": 1, "to_size": 3, "reason": "r"},
+        {"t": 2.0, "phase": "settled", "decision_id": "scale-0001",
+         "to_size": 3, "healthy": True},
+        {"t": 3.0, "phase": "decided", "decision_id": "scale-0002",
+         "from_size": 3, "to_size": 2, "reason": "r"},
+        {"t": 4.0, "phase": "applied", "decision_id": "scale-0002",
+         "to_size": 2},
+    ]
+    state = replay_scale_journal(rows)
+    assert state["terminal"] == {"scale-0001"}
+    assert state["inflight"]["decision_id"] == "scale-0002"
+    assert state["inflight"]["last_phase"] == "applied"
+
+
+def test_replay_aborted_is_terminal():
+    rows = [
+        {"t": 1.0, "phase": "decided", "decision_id": "scale-0001",
+         "from_size": 1, "to_size": 3, "reason": "r"},
+        {"t": 2.0, "phase": "aborted", "decision_id": "scale-0001",
+         "to_size": 3, "error": "boom"},
+    ]
+    state = replay_scale_journal(rows)
+    assert state["terminal"] == {"scale-0001"}
+    assert state["inflight"] is None
+
+
+# ---------------------------------------------------------------------------
+# run_once(): confirm streaks, cooldown, journal lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_run_once_journals_then_acts_then_settles(tmp_path):
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target)
+    row = daemon.run_once()
+    assert row["phase"] == "settled"
+    assert row["healthy"] is True
+    assert target.size == 3
+    phases = [r["phase"]
+              for r in PromotionJournal.load(daemon.journal.path)]
+    assert phases == ["decided", "applied", "settled"]
+    decided = PromotionJournal.load(daemon.journal.path)[0]
+    assert decided["from_size"] == 1
+    assert decided["to_size"] == 3
+    assert decided["reason"].startswith("scale_up")
+
+
+def test_confirm_streak_rides_out_one_sample_blips(tmp_path):
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target, confirm_samples=2)
+    assert daemon.run_once() is None  # one sample: unconfirmed
+    target.p99 = 100.0  # blip over: streak resets
+    assert daemon.run_once() is None
+    target.p99 = 900.0
+    assert daemon.run_once() is None  # fresh streak, sample 1
+    assert daemon.run_once()["phase"] == "settled"  # sample 2: confirmed
+    assert target.size == 3
+
+
+def test_cooldown_separates_decisions(tmp_path):
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target, cooldown_s=60.0)
+    assert daemon.run_once()["phase"] == "settled"
+    assert target.size == 3
+    # Still breaching, but inside the cooldown window: hold.
+    assert daemon.run_once() is None
+    assert target.size == 3
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe idempotency: journal replay at every kill boundary
+# (mirrors tests/test_promotion.py's promotion-daemon contract)
+# ---------------------------------------------------------------------------
+
+
+class _Killed(BaseException):
+    """In-process stand-in for SIGKILL: aborts the pipeline mid-phase;
+    the daemon object is then discarded and a fresh one replays the
+    journal — the exact artifact state a real SIGKILL leaves (the real
+    signal path is proven by the autoscale chaos run's daemon
+    subprocess)."""
+
+
+def _kill_at_phase(monkeypatch, phase):
+    def hook(p):
+        if p == phase:
+            raise _Killed(f"phase {p}")
+
+    monkeypatch.setattr(asc.faultinject, "autoscaler_phase", hook)
+
+
+def _disarm(monkeypatch):
+    monkeypatch.setattr(
+        asc.faultinject, "autoscaler_phase", lambda p: None
+    )
+
+
+@pytest.mark.parametrize(
+    "kill_phase,resizes_before",
+    [
+        (asc.KILL_PRE_APPLY, 0),   # decided journaled, fleet untouched
+        (asc.KILL_POST_APPLY, 1),  # fleet resized, applied row unwritten
+        (asc.KILL_PRE_SETTLE, 1),  # applied journaled, settle unconfirmed
+    ],
+)
+def test_journal_replay_after_kill_at_phase_boundary(
+    tmp_path, monkeypatch, kill_phase, resizes_before
+):
+    """SIGKILL at each phase boundary, restart, resume exactly-once:
+    the fleet lands at the journaled TARGET size (resize is idempotent
+    on it, so re-issuing is safe on either side of the kill) and the
+    decision settles exactly once."""
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target)
+    _kill_at_phase(monkeypatch, kill_phase)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+    assert len(target.resize_calls) == resizes_before
+    assert target.size == (1 if resizes_before == 0 else 3)
+
+    _disarm(monkeypatch)
+    daemon2 = make_daemon(tmp_path, target)
+    row = daemon2.run_once()  # journal replay drives the resume
+    assert row["phase"] == "settled"
+    assert row["resumed"] is True
+    assert target.size == 3
+    rows = PromotionJournal.load(daemon2.journal.path)
+    settled = [r for r in rows if r["phase"] == "settled"
+               and r["decision_id"] == "scale-0001"]
+    assert len(settled) == 1, "exactly one settle, ever"
+    assert any(r["phase"] == "resumed" for r in rows)
+    # Every re-issued resize asked for the SAME journaled target: no
+    # delta was replayed, so no double-spawned replica is possible.
+    assert set(target.resize_calls) == {3}
+
+    # Idempotent forever after: a held fleet changes nothing (p99 parked
+    # between the thresholds).
+    target.p99 = 100.0
+    assert daemon2.run_once() is None
+    assert target.size == 3
+
+
+def test_double_crash_after_resume_still_single_settle(
+    tmp_path, monkeypatch
+):
+    """Kill pre-apply, resume, kill again post-apply (after the
+    ``resumed`` row), restart: the decision still settles exactly once
+    and the fleet holds the one journaled target."""
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target)
+    _kill_at_phase(monkeypatch, asc.KILL_PRE_APPLY)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+    assert target.size == 1
+
+    # Second incarnation dies mid-resume, after re-issuing the resize
+    # but before the ``applied`` row lands.
+    _kill_at_phase(monkeypatch, asc.KILL_POST_APPLY)
+    daemon2 = make_daemon(tmp_path, target)
+    with pytest.raises(_Killed):
+        daemon2.run_once()
+    assert target.size == 3
+
+    _disarm(monkeypatch)
+    daemon3 = make_daemon(tmp_path, target)
+    row = daemon3.run_once()
+    assert row["phase"] == "settled"
+    rows = PromotionJournal.load(daemon3.journal.path)
+    assert sum(1 for r in rows if r["phase"] == "settled") == 1
+    assert sum(1 for r in rows if r["phase"] == "resumed") == 2
+    assert set(target.resize_calls) == {3}
+
+
+def test_resume_skips_duplicate_applied_row(tmp_path, monkeypatch):
+    """Killed between ``applied`` and ``settled``: the resume re-issues
+    the idempotent resize but does NOT journal a second ``applied`` row
+    — the journal stays a truthful single-drive record."""
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target)
+    _kill_at_phase(monkeypatch, asc.KILL_PRE_SETTLE)
+    with pytest.raises(_Killed):
+        daemon.run_once()
+
+    _disarm(monkeypatch)
+    daemon2 = make_daemon(tmp_path, target)
+    assert daemon2.run_once()["phase"] == "settled"
+    rows = PromotionJournal.load(daemon2.journal.path)
+    assert sum(1 for r in rows if r["phase"] == "applied") == 1
+
+
+def test_fresh_decisions_never_collide_with_journaled_ids(tmp_path):
+    """Decision ids continue past the journaled history after a
+    restart — a resumed daemon must not reuse ``scale-0001``."""
+    target = StubScaleTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target)
+    assert daemon.run_once()["phase"] == "settled"
+
+    target.p99 = 10.0  # now idle: the next decision scales down
+    daemon2 = make_daemon(tmp_path, target)
+    row = daemon2.run_once()
+    assert row["phase"] == "settled"
+    assert row["decision_id"] == "scale-0002"
+    assert target.size == 2
+
+
+def test_transport_failure_aborts_and_is_terminal(tmp_path):
+    """A fleet that refuses the resize journals ``aborted`` (terminal):
+    the next observation re-decides instead of wedging on the corpse."""
+
+    class RefusingTarget(StubScaleTarget):
+        def resize(self, n):
+            raise asc.PromotionTransportError("fleet unreachable")
+
+    target = RefusingTarget(size=1, p99=900.0)
+    daemon = make_daemon(tmp_path, target)
+    row = daemon.run_once()
+    assert row["phase"] == "aborted"
+    state = replay_scale_journal(
+        PromotionJournal.load(daemon.journal.path)
+    )
+    assert state["inflight"] is None  # terminal: nothing to resume

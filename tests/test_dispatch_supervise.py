@@ -277,3 +277,37 @@ def test_env_fault_plan_is_consumed_by_first_phase_only(harness, monkeypatch):
     assert rc == 0
     assert calls[0]["faults"] == "hang_at_iter=3"
     assert calls[1]["faults"] is None  # a degraded phase replays clean
+
+
+def test_degraded_dp_extent_divisibility_edges():
+    """The half-step ladder skips extents the run's own constraints refuse
+    — global-batch divisibility AND an active --task_chunk multiple — and
+    honestly returns None when nothing smaller divides."""
+    from howtotrainyourmamlpytorch_tpu.parallel import degraded_dp_extent
+
+    # Clean powers of two: plain halving.
+    assert degraded_dp_extent(8, global_batch=16) == 4
+    assert degraded_dp_extent(2, global_batch=16) == 1
+    # dp already 1: no smaller extent exists.
+    assert degraded_dp_extent(1, global_batch=16) is None
+    # Batch divisibility skips a rung: 10 % 4 != 0, so 8 → (4 refused)
+    # → 2.
+    assert degraded_dp_extent(8, global_batch=10) == 2
+    # Odd batch: only dp 1 divides everything.
+    assert degraded_dp_extent(8, global_batch=7) == 1
+    # Active task_chunk must ALSO be a multiple of the candidate
+    # (sharding.guard_task_chunk): chunk 2 refuses dp 4, lands on 2.
+    assert degraded_dp_extent(8, global_batch=16, task_chunk=2) == 2
+    # chunk 1 forces all the way down to dp 1.
+    assert degraded_dp_extent(8, global_batch=16, task_chunk=1) == 1
+    # task_chunk <= 0 means inactive: no constraint.
+    assert degraded_dp_extent(4, global_batch=8, task_chunk=0) == 2
+    assert degraded_dp_extent(4, global_batch=8, task_chunk=-1) == 2
+    # Non-power-of-two dp halves via integer division: 6 → 3 → 1.
+    assert degraded_dp_extent(6, global_batch=9) == 3
+    # ...but 3 is skipped when the batch refuses it: 6 → (3 refused) → 1.
+    assert degraded_dp_extent(6, global_batch=8) == 1
+    # A chunk that divides no intermediate rung still lands on dp 1 —
+    # every chunk is a multiple of 1, so a viable single-device fallback
+    # always exists once the batch divides (it always does at 1).
+    assert degraded_dp_extent(4, global_batch=4, task_chunk=3) == 1

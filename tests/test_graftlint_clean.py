@@ -191,6 +191,22 @@ PLANES = {
         "expect": {"geometry.py", "synth_geometry.py"},
         "zero_suppressions": True,
     },
+    "resource-plane": {
+        # ISSUE 20: the self-driving resource plane — declarative knob
+        # space + ledger-guided autotuner, journal-backed autoscaler
+        # daemon, and their CLIs — lints clean standalone with zero
+        # suppressions (incl. durable-write on the journal/gates paths
+        # and thread-lifecycle/signal-handler rules on the daemon).
+        "targets": [
+            f"{PKG}/tune", f"{PKG}/serve/resilience/autoscaler.py",
+            "tools/autotune.py", "tools/autoscaler_daemon.py",
+            "tools/serve_loadtest.py",
+        ],
+        "expect": {"__init__.py", "space.py", "autotuner.py",
+                   "autoscaler.py", "autotune.py", "autoscaler_daemon.py",
+                   "serve_loadtest.py"},
+        "zero_suppressions": True,
+    },
     "program-plane": {
         # ISSUE 17: the IR-level program analyzer and the fused-collective
         # machinery its budget rule enforces lint clean under the full

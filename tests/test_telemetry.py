@@ -1365,3 +1365,82 @@ def test_report_device_section_renders_and_tolerates_empty_ledger(
     bare_summary = summarize(read_events(bare.path))
     assert bare_summary["device"] is None
     assert "device-resource ledger" not in render_text(bare_summary)
+
+
+def test_anomaly_detector_short_history_never_fires_or_crashes():
+    """Histories shorter than the warmup (and windows shorter than the
+    p95's nominal 128 samples) are the cold-start norm — every detector
+    entry point must stay quiet AND well-defined on them, not just after
+    hundreds of samples."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import (
+        RollingAnomalyDetector,
+    )
+
+    det = RollingAnomalyDetector(warmup=16)
+    # Empty history: stats are None (the heartbeat omits windowed
+    # figures), not a zero-division or an empty-max crash.
+    assert det.window_stats("step_time") is None
+    assert det.window_stats("never_fed") is None
+    # One sample: stats well-defined, p95 IS that sample.
+    assert det.observe("step_time", 0.1) is None
+    stats = det.window_stats("step_time")
+    assert stats == {
+        "count": 1, "sum_s": 0.1, "mean_s": 0.1, "p95_s": 0.1,
+    }
+    # Exactly warmup-1 samples in the window: still disarmed — the 16th
+    # overall sample (window holds 15) cannot fire however absurd.
+    for _ in range(14):
+        det.observe("step_time", 0.1)
+    assert det.observe("step_time", 1e6) is None  # window len 15 < 16
+    # That monster JOINED the window (pre-warmup samples are never
+    # classified, so nothing is withheld) — now armed, and the p95 over
+    # the short window includes it, so detection self-calibrates to the
+    # poisoned cold start rather than firing on the next big sample.
+    stats = det.window_stats("step_time")
+    assert stats["count"] == 16
+    assert stats["p95_s"] == 1e6
+    assert det.observe("step_time", 2e6) is None  # 2e6 < 3 * p95
+    assert det.reports == 0
+
+
+def test_anomaly_p95_short_window_index_edges():
+    """The p95 order-statistic index stays in range on 1- and 2-sample
+    windows (min(int(.95*n), n-1)) and picks the max on both."""
+    from howtotrainyourmamlpytorch_tpu.telemetry import (
+        RollingAnomalyDetector,
+    )
+
+    det = RollingAnomalyDetector(warmup=2)
+    det.observe("data_wait", 0.3)
+    assert det.window_stats("data_wait")["p95_s"] == 0.3
+    det.observe("data_wait", 0.1)
+    # Two samples: index min(int(1.9), 1) = 1 → the larger one.
+    assert det.window_stats("data_wait")["p95_s"] == 0.3
+    # Armed at exactly warmup=2: a clear outlier fires against the
+    # 2-sample p95 — short histories arm as soon as contracted, no more.
+    fired = det.observe("data_wait", 1.1)
+    assert fired is not None and fired["window"] == 2
+
+
+def test_memory_growth_short_history_below_consecutive_never_fires():
+    """A rise shorter than the consecutive-windows contract never fires,
+    however large; a fresh detector tolerates any first sample."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.anomaly import (
+        MemoryGrowthDetector,
+    )
+
+    det = MemoryGrowthDetector(consecutive=3, min_delta_bytes=1 << 20)
+    # First-ever sample (no baseline): quiet.
+    assert det.observe(10 << 30) is None
+    # Two rising samples (one short of the contract): quiet despite a
+    # multi-GB climb.
+    assert det.observe(12 << 30) is None
+    assert det.observe(14 << 30) is None
+    # A dip resets the run — the NEXT two rises are again one short.
+    assert det.observe(11 << 30) is None
+    assert det.observe(13 << 30) is None
+    assert det.observe(15 << 30) is None
+    assert det.reports == 0
+    # The third consecutive rise completes the contract and fires.
+    fired = det.observe(17 << 30)
+    assert fired is not None and fired["windows"] == 3

@@ -1,0 +1,143 @@
+"""Autoscaler daemon CLI: journal-backed load-following fleet size.
+
+Watches a serving front door's ``/healthz`` + ``/metrics`` (and
+optionally a trainer/fleet heartbeat ``status.json`` for memory
+watermarks) against a declared policy, and scales the replica pool
+through POST ``/admin/scale`` — every decision journaled BEFORE the
+fleet is touched (``logs/autoscale.jsonl``), so SIGKILL at any phase
+boundary resumes exactly-once: no double-spawned replica, no orphan.
+
+Usage::
+
+    python tools/autoscaler_daemon.py \
+        --target http://127.0.0.1:8080 \
+        --journal <experiment>/logs/autoscale.jsonl \
+        [--heartbeat <experiment>/logs/status.json] \
+        [--min-replicas 1] [--max-replicas 8] \
+        [--up-queue-per-replica 4.0] [--up-p99-ms 250] \
+        [--down-queue-per-replica 0.5] [--down-p99-ms 50] \
+        [--step-up 2] [--step-down 1] [--cooldown-s 5] \
+        [--settle-timeout-s 30] [--confirm-samples 2] \
+        [--poll-interval-s 1.0] [--telemetry <path>] [--once]
+
+Runs until SIGTERM/SIGINT; ``--once`` drives a single
+observe→decide→apply→settle pass (scripting/tests/chaos).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_daemon(opts):
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.autoscaler import (
+        AutoscalerConfig,
+        AutoscalerDaemon,
+        AutoscalerPolicy,
+        HttpScaleTarget,
+    )
+
+    policy = AutoscalerPolicy(
+        min_replicas=opts.min_replicas,
+        max_replicas=opts.max_replicas,
+        up_queue_per_replica=opts.up_queue_per_replica,
+        up_p99_ms=opts.up_p99_ms,
+        down_queue_per_replica=opts.down_queue_per_replica,
+        down_p99_ms=opts.down_p99_ms,
+        step_up=opts.step_up,
+        step_down=opts.step_down,
+        cooldown_s=opts.cooldown_s,
+        settle_timeout_s=opts.settle_timeout_s,
+        confirm_samples=opts.confirm_samples,
+    )
+    config = AutoscalerConfig(
+        journal_path=os.path.abspath(opts.journal),
+        poll_interval_s=opts.poll_interval_s,
+        heartbeat_path=opts.heartbeat,
+    )
+    return AutoscalerDaemon(HttpScaleTarget(opts.target), config, policy)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--target", required=True,
+                        help="serving front-door base URL (http://host:port)")
+    parser.add_argument("--journal", required=True,
+                        help="scale-decision journal path "
+                        "(e.g. <exp>/logs/autoscale.jsonl)")
+    parser.add_argument("--heartbeat", default=None,
+                        help="heartbeat status.json for memory-watermark "
+                        "scale-up vetoes (optional)")
+    parser.add_argument("--telemetry", default=None,
+                        help="telemetry JSONL to append autoscale events "
+                        "to ('none'/unset disables)")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=8)
+    parser.add_argument("--up-queue-per-replica", type=float, default=4.0)
+    parser.add_argument("--up-p99-ms", type=float, default=250.0)
+    parser.add_argument("--down-queue-per-replica", type=float, default=0.5)
+    parser.add_argument("--down-p99-ms", type=float, default=50.0)
+    parser.add_argument("--step-up", type=int, default=2)
+    parser.add_argument("--step-down", type=int, default=1)
+    parser.add_argument("--cooldown-s", type=float, default=5.0)
+    parser.add_argument("--settle-timeout-s", type=float, default=30.0)
+    parser.add_argument("--confirm-samples", type=int, default=2)
+    parser.add_argument("--poll-interval-s", type=float, default=1.0)
+    parser.add_argument("--once", action="store_true",
+                        help="one observe/decide/apply pass, then exit")
+    opts = parser.parse_args(argv)
+
+    from howtotrainyourmamlpytorch_tpu.telemetry import events as tel_events
+    from howtotrainyourmamlpytorch_tpu.telemetry.events import EventLog
+
+    sink = None
+    if opts.telemetry and opts.telemetry != "none":
+        os.makedirs(
+            os.path.dirname(os.path.abspath(opts.telemetry)), exist_ok=True
+        )
+        sink = EventLog(opts.telemetry)
+        tel_events.install(sink)
+        tel_events.ensure_trace_id()
+
+    daemon = build_daemon(opts)
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    previous = {}
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            previous[signum] = signal.signal(signum, _graceful)
+        except (ValueError, OSError):
+            pass
+    try:
+        if opts.once:
+            daemon.run_once()
+        else:
+            print(
+                f"autoscaler watching {opts.target} "
+                f"(journal {daemon.config.journal_path})",
+                flush=True,
+            )
+            daemon.run(stop)
+    finally:
+        if sink is not None:
+            sink.flush()
+            tel_events.install(None)
+        for signum, handler in previous.items():
+            try:
+                signal.signal(signum, handler)
+            except (ValueError, OSError):
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,209 @@
+"""Autotune CLI: zero-human-choice knob tuning with judged receipts.
+
+One invocation classifies the machine's roofline regime (ProgramLedger
+arithmetic intensity vs ``PEAK_FLOPS_BY_KIND`` over the HBM ridge),
+ranks candidate single-knob moves from the declared space
+(``tune/space.py``), drives short A/B probes under bench's contention-
+sentinel protocol, hands the best candidate to ``tools/bench_judge``
+mechanically, and — on a ``keep`` verdict — appends the winning gate to
+``tools/bench_gates.json`` with provenance ``source: autotune:<run_id>``
+plus the probe emissions as ``AUTOTUNE_<run_id>_r0{1,2}.json``.
+
+Usage::
+
+    python tools/autotune.py                      # probe, judge, append
+    python tools/autotune.py --dry-run            # probe + judge only
+    python tools/autotune.py --json               # machine-readable
+    python tools/autotune.py --run-id r01 --min-gain 0.05 \
+        [--max-candidates 6] [--out .] [--gates tools/bench_gates.json]
+
+Exit codes: 0 = a winner was judged ``keep`` (and appended unless
+``--dry-run``); 2 = no candidate beat the gate (every verdict revert) or
+every probe was sentinel-contended — nothing was appended.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _machine_facts():
+    """Device count/kind + the roofline inputs, measured on THIS machine.
+    Cost analysis is backend-optional (CPU returns None) — the regime
+    then honestly classifies as dispatch-bound."""
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.telemetry.device import (
+        ProgramLedger,
+        record_train_program,
+        resolve_peak_flops,
+    )
+    from howtotrainyourmamlpytorch_tpu.tune.autotuner import (
+        ProbeSpec,
+        _probe_batch,
+        _probe_config,
+    )
+
+    devices = jax.devices()
+    kind = devices[0].device_kind
+    peak = resolve_peak_flops(kind)
+    intensity = None
+    try:
+        import numpy as np
+
+        from howtotrainyourmamlpytorch_tpu.models import MAMLFewShotLearner
+
+        spec = ProbeSpec()
+        cfg = _probe_config({}, spec)
+        learner = MAMLFewShotLearner(cfg)
+        state = learner.init_state(jax.random.PRNGKey(0))
+        batches = [_probe_batch(spec, np.random.RandomState(1))]
+        ledger = ProgramLedger(emit_events=False)
+        entry = record_train_program(ledger, learner, state, batches, 0)
+        if entry is not None and entry.flops:
+            intensity = entry.arithmetic_intensity
+    except Exception as exc:  # noqa: BLE001 — classification is best-effort
+        print(f"# roofline probe unavailable: {exc}", file=sys.stderr)
+    return len(devices), kind, peak, intensity
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--run-id", default=None,
+                        help="provenance id (default: next free autotune "
+                        "rNN from existing AUTOTUNE_* files in --out)")
+    parser.add_argument("--out", default=".",
+                        help="where AUTOTUNE_<run_id>_r0*.json land")
+    parser.add_argument("--gates", default=os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "bench_gates.json"),
+                        help="gates file the winning verdict appends to")
+    parser.add_argument("--min-gain", type=float, default=0.05,
+                        help="the judged bar: candidate must beat baseline "
+                        "by this fraction (gate expression)")
+    parser.add_argument("--max-candidates", type=int, default=6)
+    parser.add_argument("--global-batch", type=int, default=8,
+                        help="meta-batch size the divisibility guards "
+                        "check candidates against")
+    parser.add_argument("--window-iters", type=int, default=50,
+                        help="meta-iterations per probe timing window")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="probe + judge, but never touch the gates "
+                        "file or write emissions")
+    parser.add_argument("--json", action="store_true")
+    opts = parser.parse_args(argv)
+
+    from howtotrainyourmamlpytorch_tpu.tune.autotuner import (
+        BASELINE_KEY,
+        PROBE_KEY,
+        ProbeSpec,
+        append_gate,
+        autotune_run,
+    )
+    from howtotrainyourmamlpytorch_tpu.tune.space import TuneContext
+
+    n_devices, kind, peak, intensity = _machine_facts()
+    run_id = opts.run_id or _next_run_id(opts.out)
+    result = autotune_run(
+        run_id=run_id,
+        ctx=TuneContext(
+            n_devices=n_devices, dp=1, mp=1, global_batch=opts.global_batch
+        ),
+        spec=ProbeSpec(
+            batch_size=opts.global_batch, window_iters=opts.window_iters
+        ),
+        min_gain=opts.min_gain,
+        max_candidates=opts.max_candidates,
+        device_kind=kind,
+        peak_flops=peak,
+        arithmetic_intensity=intensity,
+    )
+
+    appended = False
+    if result.get("winner") and not opts.dry_run:
+        for run in result["emissions"]:
+            path = os.path.join(opts.out, run["name"])
+            with open(path, "w") as f:
+                json.dump({"n": run["n"], "parsed": run["parsed"]}, f,
+                          indent=2)
+                f.write("\n")
+        append_gate(
+            opts.gates,
+            PROBE_KEY,
+            result["winner"]["gate_entry"],
+            ungated_extra=(
+                BASELINE_KEY, "autotune_knob", "autotune_value",
+            ),
+        )
+        appended = True
+    result["gates_appended"] = appended
+
+    if opts.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(_render(result))
+    return 0 if result.get("winner") else 2
+
+
+def _next_run_id(out_dir: str) -> str:
+    import glob
+    import re
+
+    taken = set()
+    for path in glob.glob(os.path.join(out_dir, "AUTOTUNE_r*_r0*.json")):
+        match = re.search(r"AUTOTUNE_(r\d+)_", os.path.basename(path))
+        if match:
+            taken.add(match.group(1))
+    n = 1
+    while f"r{n:02d}" in taken:
+        n += 1
+    return f"r{n:02d}"
+
+
+def _render(result: dict) -> str:
+    lines = [
+        f"autotune {result['run_id']} — regime {result['regime']} "
+        f"({result['regime_reason']})"
+    ]
+    base = result.get("baseline")
+    lines.append(
+        f"  baseline: "
+        + (f"{base:.2f} meta-iters/s" if base else "DISCARDED (contended)")
+    )
+    for probe in result.get("probes", []):
+        measured = probe["measured"]
+        lines.append(
+            f"  probe {probe['knob']}={probe['value']}: "
+            + (f"{measured:.2f} meta-iters/s"
+               if measured is not None else "DISCARDED (contended)")
+        )
+    judge = result.get("judge")
+    if judge:
+        lines.append(
+            f"  judge: {judge['verdict']} — {judge['reason']} "
+            f"(gate {judge['gate']})"
+        )
+    winner = result.get("winner")
+    if winner:
+        lines.append(
+            f"  WINNER {winner['lever']}: {winner['baseline']:.2f} -> "
+            f"{winner['measured']:.2f} meta-iters/s "
+            f"(+{winner['gain'] * 100:.0f}%), fingerprint "
+            f"{winner['config_fingerprint']}"
+            + ("; gate appended" if result.get("gates_appended")
+               else "; dry run — gate NOT appended")
+        )
+    elif "error" in result:
+        lines.append(f"  {result['error']}")
+    else:
+        lines.append("  no candidate beat the gate — nothing appended")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,6 +10,7 @@ applies them mechanically over the full trajectory::
     python -m tools.bench_judge                 # human table
     python -m tools.bench_judge --json          # machine-readable
     python -m tools.bench_judge --trajectory BENCH_r0*.json
+    python -m tools.bench_judge --explain KEY   # one gate, full history
 
 Per gated key, one verdict:
 
@@ -361,6 +362,99 @@ def judge(gates_doc: dict, runs: list[dict]) -> dict:
     }
 
 
+def explain(gates_doc: dict, runs: list[dict], key: str) -> dict:
+    """One key, fully accounted for: the gate's provenance (source, lever,
+    expression, tolerances, pending-until marker) plus the verdict the
+    judge would have returned after EVERY prefix of the trajectory — the
+    key's whole history, not just today's verdict. Contended runs appear
+    in the history as skipped, exactly as the judge treats them."""
+    gates = gates_doc["gates"]
+    if key not in gates:
+        ungated_ok = set(gates_doc.get("ungated_ok", []))
+        where = (
+            "listed in ungated_ok (deliberately carries no gate)"
+            if key in ungated_ok else "not in the gates file at all"
+        )
+        raise ValueError(f"no gate entry for {key!r} — {where}")
+    spec = gates[key]
+    default_tolerance = float(gates_doc.get("default_tolerance", 0.08))
+
+    history = []
+    for end in range(len(runs)):
+        run = runs[end]
+        if run["contended"]:
+            history.append({
+                "n": run["n"], "run": run["name"],
+                "value": _numeric(run["parsed"].get(key)),
+                "verdict": "skipped", "reason": "contended emission",
+            })
+            continue
+        # Judge the prefix ending here: the verdict this run produced
+        # when it WAS the latest accepted emission.
+        entry = judge(gates_doc, runs[:end + 1])["verdicts"][key]
+        history.append({
+            "n": run["n"], "run": run["name"],
+            "value": entry["value"],
+            "verdict": entry["verdict"], "reason": entry["reason"],
+        })
+
+    return {
+        "key": key,
+        "source": spec.get("source", "bench.py"),
+        "lever": spec.get("lever"),
+        "gate": spec.get("gate"),
+        "direction": str(spec.get("direction", "higher")),
+        "tolerance": float(spec.get("tolerance", default_tolerance)),
+        "abs_slack": float(spec.get("abs_slack", 0.0)),
+        "gate_from_run": spec.get("gate_from_run"),
+        "perf_notes": spec.get("perf_notes"),
+        "note": spec.get("note"),
+        "history": history,
+        "current": history[-1] if history else None,
+    }
+
+
+def render_explain(result: dict) -> str:
+    lines = [f"bench judge — {result['key']}"]
+    lines.append(f"  source:    {result['source']}")
+    if result["lever"]:
+        lines.append(f"  lever:     {result['lever']}")
+    if result["gate"]:
+        qualifier = (
+            f" (in force from run {int(result['gate_from_run'])})"
+            if result["gate_from_run"] is not None else ""
+        )
+        lines.append(f"  gate:      {result['gate']}{qualifier}")
+    else:
+        lines.append("  gate:      none — regression-tracked only")
+    lines.append(
+        f"  regression bar: direction {result['direction']}, tolerance "
+        f"{result['tolerance']:g} of the last accepted value"
+        + (f" (+{result['abs_slack']:g} absolute)"
+           if result["abs_slack"] else "")
+    )
+    if result["perf_notes"]:
+        lines.append(f"  perf_notes: §{result['perf_notes']}")
+    if result["note"]:
+        lines.append(f"  note:      {result['note']}")
+    lines.append("")
+    lines.append(f"  {'n':>3} {'run':<28} {'value':>12} {'verdict':<8} reason")
+    lines.append("  " + "-" * 76)
+    for row in result["history"]:
+        value = "—" if row["value"] is None else f"{row['value']:g}"
+        lines.append(
+            f"  {row['n']:>3} {row['run']:<28} {value:>12} "
+            f"{row['verdict']:<8} {row['reason']}"
+        )
+    current = result["current"]
+    if current is not None:
+        lines.append("")
+        lines.append(
+            f"  current: {current['verdict']} — {current['reason']}"
+        )
+    return "\n".join(lines)
+
+
 def render_text(result: dict) -> str:
     lines = []
     lines.append(
@@ -439,6 +533,10 @@ def main(argv=None) -> int:
                         help="gate data (default: tools/bench_gates.json)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable result instead of the table")
+    parser.add_argument("--explain", metavar="KEY", default=None,
+                        help="drill into one gate: provenance (source, "
+                        "lever, expression, tolerances) + the verdict "
+                        "history over every run of the trajectory")
     opts = parser.parse_args(argv)
 
     paths = opts.trajectory or default_trajectory()
@@ -447,7 +545,14 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     try:
-        result = judge(load_gates(opts.gates), load_trajectory(paths))
+        gates_doc = load_gates(opts.gates)
+        runs = load_trajectory(paths)
+        if opts.explain:
+            result = explain(gates_doc, runs, opts.explain)
+            print(json.dumps(result) if opts.json
+                  else render_explain(result))
+            return 0
+        result = judge(gates_doc, runs)
     except (OSError, ValueError) as exc:
         print(f"bench_judge: {exc}", file=sys.stderr)
         return 2

@@ -1042,6 +1042,420 @@ def run_promote_chaos(
     return verdict
 
 
+#: Wall budget for the autoscale chaos run (fleet growth under genuine
+#: overload + SIGKILL-resume + latency-window flush to the scale-down).
+AUTOSCALE_TIMEOUT_S = 600
+
+AUTOSCALER_DAEMON = os.path.join("tools", "autoscaler_daemon.py")
+
+
+def _autoscaler_argv(
+    exp_dir: str, url: str, up_p99_ms: float, down_p99_ms: float
+) -> list[str]:
+    return [
+        sys.executable, "-u", os.path.join(REPO, AUTOSCALER_DAEMON),
+        "--target", url,
+        "--journal", os.path.join(exp_dir, "logs", "autoscale.jsonl"),
+        "--telemetry", os.path.join(exp_dir, "logs", "telemetry.jsonl"),
+        "--min-replicas", "1", "--max-replicas", "3",
+        "--step-up", "2", "--step-down", "1",
+        "--up-p99-ms", f"{up_p99_ms:.1f}",
+        "--down-p99-ms", f"{down_p99_ms:.1f}",
+        "--cooldown-s", "1.0", "--confirm-samples", "2",
+        "--poll-interval-s", "0.25", "--settle-timeout-s", "120",
+    ]
+
+
+def _read_scale_journal(exp_dir: str) -> list[dict]:
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.promotion import (
+        PromotionJournal,
+    )
+
+    return PromotionJournal.load(
+        os.path.join(exp_dir, "logs", "autoscale.jsonl")
+    )
+
+
+def run_autoscale_chaos(workdir: str, verbose: bool = True) -> dict:
+    """The self-driving fleet, end to end, zero intervention: a
+    1-replica pool serves adapt-heavy overload while the autoscaler
+    daemon CLI (its own process) watches the HTTP front door and drives
+    the fleet through POST ``/admin/scale``. Faults, each mapping to
+    its documented recovery:
+
+    * ``autoscaler_kill_at_phase=1`` (daemon env) — the daemon is
+      SIGKILLed with the scale-up DECIDED row journaled but the fleet
+      untouched (the journal-then-act window): the restarted daemon
+      replays the journal, journals ``resumed`` and re-issues the SAME
+      target size — idempotent, so the fleet settles at 3 exactly once,
+      no double-spawned replica;
+    * ``replica_kill_at_request`` — one replica dies mid-stream under
+      live traffic: the pool re-dispatches the request, the caller
+      never sees it, the supervisor re-warms the slot;
+    * organic load swing — thresholds are derived from measured probe
+      latencies on THIS machine, the overload is genuinely slow
+      (distinct support sets, every request pays the inner loop) and
+      the idle phase genuinely fast (cache-hit flush), so both the
+      scale-up and the scale-down decisions come from the policy
+      reading real signals, not from stubbed metrics.
+
+    Asserted outcome: >= 1 scale-up and >= 1 scale-down decided +
+    settled, the SIGKILL resume exactly-once (no decision driven
+    twice), the replica death recovered, and ZERO failed requests
+    across every phase."""
+    import threading as _threading
+
+    from howtotrainyourmamlpytorch_tpu.serve import make_http_server
+    from howtotrainyourmamlpytorch_tpu.serve.pool import (
+        PoolConfig,
+        ReplicaPool,
+    )
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.promotion import (
+        parse_prometheus,
+    )
+    from howtotrainyourmamlpytorch_tpu.serve.resilience.replica import (
+        LocalReplica,
+    )
+    from howtotrainyourmamlpytorch_tpu.telemetry import events as tel_events
+    from howtotrainyourmamlpytorch_tpu.telemetry.events import EventLog
+    from howtotrainyourmamlpytorch_tpu.utils import faultinject
+    from tools.serve_loadtest import run_loadtest, synth_episodes
+
+    def log(msg):
+        if verbose:
+            print(f"chaos: {msg}", file=sys.stderr, flush=True)
+
+    cfg_path = tiny_config(workdir, "chaos_autoscale", devices=1)
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    exp_dir = cfg["experiment_name"]
+    os.makedirs(os.path.join(exp_dir, "logs"), exist_ok=True)
+    telemetry_path = os.path.join(exp_dir, "logs", "telemetry.jsonl")
+
+    previous_dataset_dir = os.environ.get("DATASET_DIR")
+    os.environ["DATASET_DIR"] = workdir
+    sink = EventLog(telemetry_path)
+    previous_sink = tel_events.install(sink)
+    from tools.serve_maml import build_learner
+
+    learner = build_learner("maml", cfg_path)
+    way = int(cfg["num_classes_per_set"])
+    query = int(cfg["num_target_samples"])
+
+    def factory(index: int) -> LocalReplica:
+        import jax
+
+        from howtotrainyourmamlpytorch_tpu.serve import (
+            ServeConfig,
+            ServingAPI,
+        )
+
+        api = ServingAPI(
+            learner, learner.init_state(jax.random.PRNGKey(0)),
+            # The overload phase holds adapt-heavy requests queued for
+            # several inner-loop times on purpose; a 2s queue-age
+            # degrade would shed them (failed requests), so the age
+            # trip-wire is lifted out of the way — depth-based admission
+            # (hard cap 64, soft 16) still bounds the queue, and the
+            # harness caps in-flight below the soft limit.
+            ServeConfig(
+                meta_batch_size=2, max_wait_ms=0.0,
+                max_queue_age_ms=60_000.0,
+            ),
+        )
+        api.engine.warmup([(way, 1, query)])
+        return LocalReplica(api, replica_id=f"local-{index}")
+
+    pool = ReplicaPool(
+        factory,
+        PoolConfig(
+            n_replicas=1, health_interval_s=0.1, restart_backoff_s=0.2,
+            min_uptime_s=0.0, dispatch_timeout_s=60.0,
+        ),
+    )
+
+    def pool_deaths() -> float:
+        return parse_prometheus(pool.metrics_text()).get(
+            "maml_serve_pool_replica_deaths_total", 0.0
+        )
+
+    daemon_holder: dict | None = None
+    server = None
+    flush_stop = _threading.Event()
+    flush_lock = _threading.Lock()
+    flush_counts = {"ok": 0, "err": 0}
+    flush_threads: list = []
+    overload_results: list[dict] = []
+    verdict: dict = {"schedule": ["autoscale"], "ok": False}
+    try:
+        if not pool.wait_ready(timeout=300.0):
+            raise RuntimeError("seed replica never became healthy")
+        server = make_http_server(pool, "127.0.0.1", 0)
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}"
+        server_thread = _threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        server_thread.start()
+        log(f"pool front door on {url} (1 replica)")
+
+        bb = learner.cfg.backbone
+        image_shape = (bb.image_channels, bb.image_height, bb.image_width)
+        flush_eps = synth_episodes(
+            6, way=way, shot=1, query=query, image_shape=image_shape,
+            seed=11,
+        )
+
+        # -- latency probes: policy thresholds from THIS machine --------
+        def timed_classify(episode) -> float:
+            xs, ys, xq = episode
+            t0 = time.monotonic()
+            pool.classify(xs, ys, xq, timeout=120.0)
+            return (time.monotonic() - t0) * 1e3
+
+        adapt_samples = [
+            timed_classify(ep) for ep in synth_episodes(
+                4, way=way, shot=1, query=query, image_shape=image_shape,
+                seed=5,
+            )
+        ][1:]  # first sample may carry warmup stragglers
+        timed_classify(flush_eps[0])  # pay its adapt once
+        hit_samples = [timed_classify(flush_eps[0]) for _ in range(8)]
+        adapt_ms = sorted(adapt_samples)[len(adapt_samples) // 2]
+        hit_ms = sorted(hit_samples)[len(hit_samples) // 2]
+        down_p99_ms = max(60.0, 6.0 * hit_ms)
+        up_p99_ms = max(2.2 * down_p99_ms, 1.5 * adapt_ms)
+        log(f"probes: adapt {adapt_ms:.0f}ms, cache-hit {hit_ms:.0f}ms "
+            f"-> up above {up_p99_ms:.0f}ms, down below "
+            f"{down_p99_ms:.0f}ms")
+
+        # -- autoscaler daemon, armed to die inside the act window ------
+        daemon_env = dict(os.environ)
+        daemon_env["PYTHONPATH"] = REPO + os.pathsep + daemon_env.get(
+            "PYTHONPATH", ""
+        )
+        daemon_env["JAX_PLATFORMS"] = "cpu"
+        daemon_env["MAML_FAULTS"] = "autoscaler_kill_at_phase=1"
+        argv = _autoscaler_argv(exp_dir, url, up_p99_ms, down_p99_ms)
+        daemon_holder = {"proc": subprocess.Popen(
+            argv, cwd=REPO, env=daemon_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )}
+        log("autoscaler daemon started (autoscaler_kill_at_phase=1: "
+            "SIGKILL with the decision journaled, fleet untouched)")
+        t_deadline = time.time() + AUTOSCALE_TIMEOUT_S
+
+        # -- overload: every request pays the inner loop ----------------
+        # Distinct support sets keep the adapt path honest; max_workers
+        # bounds in-flight below the soft admission limit so the p99
+        # breach arrives WITHOUT a single shed request.
+        burst = 0
+        while time.time() < t_deadline:
+            burst += 1
+            burst_eps = synth_episodes(
+                48, way=way, shot=1, query=query, image_shape=image_shape,
+                seed=100 + burst,
+            )
+            overload_results.append(run_loadtest(
+                pool, burst_eps, rate_qps=max(4.0, 3000.0 / adapt_ms),
+                duration_s=6.0, p99_budget_ms=1e9, error_slo=0.0,
+                timeout_s=120.0, seed=burst, max_workers=8,
+                sample_health=False,
+            ))
+            if any(
+                r["phase"] == "decided" for r in _read_scale_journal(exp_dir)
+            ):
+                break
+        rows = _read_scale_journal(exp_dir)
+        if not any(r["phase"] == "decided" for r in rows):
+            raise RuntimeError(
+                "overload never produced a journaled scale-up decision"
+            )
+        try:
+            rc = daemon_holder["proc"].wait(timeout=60)
+        except subprocess.TimeoutExpired as exc:
+            raise RuntimeError(
+                "daemon survived its armed kill point"
+            ) from exc
+        pre_resume = pool.healthz()
+        verdict["daemon_sigkilled"] = rc in (-9, 137)
+        verdict["fleet_untouched_at_kill"] = pre_resume["pool_size"] == 1
+        log(f"daemon SIGKILLed pre-apply (rc {rc}); decided row journaled, "
+            f"pool still size {pre_resume['pool_size']}")
+
+        # -- restart clean: journal replay drives the scale-up once -----
+        restart_env = dict(daemon_env)
+        restart_env.pop("MAML_FAULTS", None)
+        daemon_holder["proc"] = subprocess.Popen(
+            argv, cwd=REPO, env=restart_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        log("daemon restarted without faults: replaying the journal")
+        settled_up = None
+        while time.time() < t_deadline:
+            rows = _read_scale_journal(exp_dir)
+            settled = [r for r in rows if r["phase"] == "settled"]
+            if settled:
+                settled_up = settled[0]
+                break
+            time.sleep(0.3)
+        if settled_up is None:
+            raise RuntimeError("resumed scale-up never settled")
+        verdict["resumed_settled_healthy"] = bool(settled_up.get("healthy"))
+        post_up = pool.healthz()
+        verdict["pool_size_after_up"] = post_up["pool_size"]
+        log(f"scale-up settled exactly-once: pool {post_up['pool_size']} "
+            f"replicas, {post_up['healthy_replicas']} healthy")
+
+        # -- cache-hit flush + replica kill -> the scale-down -----------
+        # The pool's latency summary keeps a bounded recent window, so
+        # its p99 only falls once fast samples displace the overload's;
+        # the flush IS the light-traffic tail after the spike. The 40th
+        # flush request kills its replica mid-stream — the pool
+        # re-dispatches, so the caller never sees it.
+        deaths_before = pool_deaths()
+        faultinject.activate(
+            faultinject.FaultPlan(replica_kill_at_request=40)
+        )
+
+        def flush_worker(start: int) -> None:
+            i = start
+            while not flush_stop.is_set():
+                xs, ys, xq = flush_eps[i % len(flush_eps)]
+                i += 1
+                try:
+                    pool.classify(xs, ys, xq, timeout=60.0)
+                    key = "ok"
+                except Exception:  # noqa: BLE001 — any failure fails the verdict
+                    key = "err"
+                with flush_lock:
+                    flush_counts[key] += 1
+
+        flush_threads = [
+            _threading.Thread(target=flush_worker, args=(w,), daemon=True)
+            for w in range(6)
+        ]
+        for t in flush_threads:
+            t.start()
+        down_settled = None
+        while time.time() < t_deadline:
+            rows = _read_scale_journal(exp_dir)
+            decided_down = {
+                r["decision_id"] for r in rows
+                if r["phase"] == "decided"
+                and r.get("to_size", 0) < r.get("from_size", 0)
+            }
+            down_settled = next(
+                (r for r in rows if r["phase"] == "settled"
+                 and r["decision_id"] in decided_down),
+                None,
+            )
+            if down_settled is not None:
+                break
+            time.sleep(0.5)
+        flush_stop.set()
+        for t in flush_threads:
+            t.join(timeout=60)
+        faultinject.deactivate()
+        if down_settled is None:
+            raise RuntimeError(
+                "cache-hit flush never produced a settled scale-down"
+            )
+        verdict["replica_deaths"] = int(pool_deaths() - deaths_before)
+        log(f"scale-down settled ({down_settled['decision_id']} -> "
+            f"{down_settled['to_size']} replicas); flush "
+            f"{flush_counts['ok']} ok / {flush_counts['err']} failed; "
+            f"replica deaths {verdict['replica_deaths']}")
+    finally:
+        flush_stop.set()
+        try:
+            faultinject.deactivate()
+        except Exception:  # noqa: BLE001
+            pass
+        if daemon_holder is not None:
+            proc = daemon_holder.get("proc")
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+        for t in flush_threads:
+            t.join(timeout=10)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            server_thread.join(timeout=10)
+        pool.close()
+        tel_events.install(previous_sink)
+        sink.flush()
+        if previous_dataset_dir is None:
+            os.environ.pop("DATASET_DIR", None)
+        else:
+            os.environ["DATASET_DIR"] = previous_dataset_dir
+
+    # -- verdict --------------------------------------------------------
+    rows = _read_scale_journal(exp_dir)
+    events = _read_events(exp_dir)
+    decided = [r for r in rows if r["phase"] == "decided"]
+    ups = [r for r in decided if r["to_size"] > r["from_size"]]
+    downs = [r for r in decided if r["to_size"] < r["from_size"]]
+    resumed_rows = [r for r in rows if r["phase"] == "resumed"]
+    # Exactly-once across the SIGKILL: per decision at most one settled
+    # row, and a duplicate applied row only when a resume re-drove it.
+    by_id: dict[str, list[dict]] = {}
+    for r in rows:
+        if r.get("decision_id"):
+            by_id.setdefault(r["decision_id"], []).append(r)
+    double_driven = []
+    for did, drows in by_id.items():
+        n_settled = sum(1 for r in drows if r["phase"] == "settled")
+        applied = [r for r in drows if r["phase"] == "applied"]
+        if n_settled > 1 or (
+            len(applied) > 1
+            and not any(r.get("resumed") for r in applied)
+        ):
+            double_driven.append(did)
+    offered = sum(r["offered"] for r in overload_results) + sum(
+        flush_counts.values()
+    )
+    ok_requests = (
+        sum(r["completed_ok"] for r in overload_results)
+        + flush_counts["ok"]
+    )
+    verdict.update({
+        "devices": 1,
+        "scale_ups": len(ups),
+        "scale_downs": len(downs),
+        "resumed_rows": len(resumed_rows),
+        "settled_rows": sum(1 for r in rows if r["phase"] == "settled"),
+        "double_driven": double_driven,
+        "requests_offered": offered,
+        "requests_ok": ok_requests,
+        "requests_failed": offered - ok_requests,
+        "autoscale_event_types": sorted({
+            e["type"] for e in events
+            if str(e.get("type", "")).startswith("autoscale")
+        }),
+        "ok": bool(
+            verdict.get("daemon_sigkilled")
+            and verdict.get("fleet_untouched_at_kill")
+            and ups
+            and downs
+            and resumed_rows
+            and verdict.get("resumed_settled_healthy")
+            and not double_driven
+            and verdict.get("replica_deaths", 0) >= 1
+            and offered > 0
+            and offered == ok_requests
+        ),
+    })
+    if not verdict["ok"] and verbose:
+        log(f"verdict: {json.dumps(verdict, indent=1)}")
+    return verdict
+
+
 def measure_multihost_recovery(seed: int = 0) -> dict:
     """Bench hook behind the ``multihost_recovery_s`` standard-emission
     key: one kill-a-host chaos run through the real dispatcher CLI on a
@@ -1088,7 +1502,11 @@ def main(argv=None) -> int:
                              "loop — trainer + promotion daemon + "
                              "2-replica pool + loadtest through automatic "
                              "promotions, corrupt-candidate rejection and "
-                             "a forced SLO rollback)")
+                             "a forced SLO rollback), or 'autoscale' "
+                             "(alone: the self-driving fleet — autoscaler "
+                             "daemon + 1->3->2 replica pool under a "
+                             "measured load swing, SIGKILLed mid-scale-up "
+                             "and resumed exactly-once from its journal)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--devices", type=int, default=1,
                         help="virtual CPU mesh devices (dp extent); hangs "
@@ -1133,6 +1551,14 @@ def main(argv=None) -> int:
             verdict = run_promote_chaos(workdir, verbose=not args.json)
         elif "promote" in schedule:
             parser.error("promote runs alone: --schedule promote")
+        elif schedule == ["autoscale"]:
+            # The self-driving fleet: autoscaler daemon + replica pool
+            # under a measured overload/idle swing, through a SIGKILL
+            # inside the journal-then-act window, one replica death and
+            # a settled scale-down — its own harness.
+            verdict = run_autoscale_chaos(workdir, verbose=not args.json)
+        elif "autoscale" in schedule:
+            parser.error("autoscale runs alone: --schedule autoscale")
         else:
             verdict = run_chaos(
                 workdir, schedule, devices=args.devices,

@@ -11,6 +11,12 @@ This harness offers exactly that:
   fired on schedule regardless of completions, so an overloaded server
   faces mounting concurrency exactly like production traffic (a
   closed-loop bench self-throttles and hides overload entirely);
+* **ramp schedules** — ``--ramp lo:hi:dur[,...]`` replaces the flat rate
+  with a piecewise-triangle arrival intensity (``2:20:60`` climbs 2→20
+  qps over 60 s then back down over another 60 s), realized as a seeded
+  NONhomogeneous Poisson process via thinning — the traffic shape an
+  autoscaler must follow; ``serve_ramp_p99_ms`` reports the p99 over the
+  whole swing;
 * **SLO verdict** — ``p99 <= --p99-budget-ms`` AND ``error rate <=
   --error-slo`` over the run, printed as a machine-readable JSON line with
   ``--json`` (exit code 0 pass / 2 fail, so CI can gate on it);
@@ -52,6 +58,79 @@ OUTCOME_OK = "ok"
 OUTCOME_SHED = "shed"
 OUTCOME_DEADLINE = "deadline"
 OUTCOME_ERROR = "error"
+
+
+# ---------------------------------------------------------------------------
+# Ramp schedules (nonhomogeneous Poisson arrivals)
+# ---------------------------------------------------------------------------
+
+
+def parse_ramp(spec: str) -> list[tuple[float, float, float]]:
+    """``lo:hi:dur[,lo:hi:dur...]`` → validated ``(lo, hi, dur)`` segments.
+
+    Each segment is a TRIANGLE: the rate climbs lo→hi over ``dur``
+    seconds, then descends hi→lo over another ``dur`` seconds, so one
+    segment occupies ``2*dur`` of wall clock. Segments concatenate."""
+    segments = []
+    for part in spec.split(","):
+        fields = part.split(":")
+        if len(fields) != 3:
+            raise ValueError(
+                f"ramp segment {part!r} must be lo:hi:dur (e.g. 2:20:60)"
+            )
+        lo, hi, dur = (float(f) for f in fields)
+        if lo < 0 or hi <= 0 or dur <= 0:
+            raise ValueError(
+                f"ramp segment {part!r}: need lo >= 0, hi > 0, dur > 0"
+            )
+        if hi < lo:
+            raise ValueError(
+                f"ramp segment {part!r}: hi must be >= lo (the segment "
+                "ramps up then back down on its own)"
+            )
+        segments.append((lo, hi, dur))
+    return segments
+
+
+def ramp_rate_fn(segments):
+    """``(rate(t), total_duration_s, peak_rate)`` for triangle segments."""
+    total = sum(2.0 * dur for _, _, dur in segments)
+    peak = max(hi for _, hi, _ in segments)
+
+    def rate(t: float) -> float:
+        if t < 0 or t >= total:
+            return 0.0
+        for lo, hi, dur in segments:
+            if t < 2.0 * dur:
+                if t < dur:  # climbing
+                    return lo + (hi - lo) * (t / dur)
+                return hi - (hi - lo) * ((t - dur) / dur)  # descending
+            t -= 2.0 * dur
+        return 0.0
+
+    return rate, total, peak
+
+
+def ramp_arrivals(segments, *, seed: int = 0) -> list[float]:
+    """Seeded arrival times for the triangle schedule, by thinning.
+
+    Draw a homogeneous Poisson process at the PEAK rate over the whole
+    schedule, then keep each arrival ``t`` with probability
+    ``rate(t)/peak`` — the standard exact construction for a
+    nonhomogeneous Poisson process, so the offered stream is genuinely
+    Poisson at every instant of the ramp (bursty where it should be),
+    not a deterministic staircase."""
+    rate, total, peak = ramp_rate_fn(segments)
+    rng = np.random.RandomState(seed)
+    arrivals = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= total:
+            break
+        if rng.rand() <= rate(t) / peak:
+            arrivals.append(t)
+    return arrivals
 
 
 class _HealthSampler:
@@ -154,6 +233,8 @@ def run_loadtest(
     max_workers: int = 32,
     sample_health: bool = True,
     tag_seed_base: int | None = None,
+    arrivals: list[float] | None = None,
+    ramp: str | None = None,
 ) -> dict:
     """Offers an open-loop Poisson stream to ``target.classify`` and
     returns the measured result + SLO verdict (see module docstring).
@@ -165,16 +246,21 @@ def run_loadtest(
     tier). ``tag_seed_base`` stamps episode ``i`` with the telemetry tag
     ``seed:<base+i>`` — the replayable identity ``tools/episode_miner.py``
     mines hard episodes by (use the dataset seeds your episodes were
-    actually synthesized from when you have them)."""
-    rng = np.random.RandomState(seed)
-    # The whole arrival schedule up front: reproducible, and the firing
-    # loop does no RNG work.
-    arrivals = []
-    t = 0.0
-    while t < duration_s:
-        t += float(rng.exponential(1.0 / rate_qps))
-        if t < duration_s:
-            arrivals.append(t)
+    actually synthesized from when you have them).
+
+    ``arrivals`` overrides the flat-rate Poisson draw with a precomputed
+    schedule (e.g. ``ramp_arrivals``); ``ramp`` labels the result and
+    turns on the ``serve_ramp_p99_ms`` export."""
+    if arrivals is None:
+        rng = np.random.RandomState(seed)
+        # The whole arrival schedule up front: reproducible, and the
+        # firing loop does no RNG work.
+        arrivals = []
+        t = 0.0
+        while t < duration_s:
+            t += float(rng.exponential(1.0 / rate_qps))
+            if t < duration_s:
+                arrivals.append(t)
     results: list[tuple[str, float]] = []
     results_lock = threading.Lock()
     t_start = time.monotonic()
@@ -248,7 +334,7 @@ def run_loadtest(
     p50 = float(np.percentile(ok_latencies, 50)) if ok_latencies else 0.0
     p99 = float(np.percentile(ok_latencies, 99)) if ok_latencies else 0.0
     slo_pass = bool(p99 <= p99_budget_ms and error_rate <= error_slo)
-    return {
+    result = {
         "offered": offered,
         "completed_ok": ok,
         "shed": by_outcome[OUTCOME_SHED],
@@ -272,6 +358,10 @@ def run_loadtest(
         "slo_pass": slo_pass,
         "duration_s": round(wall_s, 3),
     }
+    if ramp is not None:
+        result["ramp"] = ramp
+        result["serve_ramp_p99_ms"] = round(p99, 3)
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -365,6 +455,13 @@ def main(argv=None) -> int:
     parser.add_argument("--rate", type=float, default=4.0,
                         help="offered Poisson arrival rate, requests/s")
     parser.add_argument("--duration-s", type=float, default=5.0)
+    parser.add_argument("--ramp", default=None, metavar="LO:HI:DUR[,...]",
+                        help="piecewise-triangle arrival schedule instead "
+                        "of the flat --rate: each segment climbs LO→HI "
+                        "qps over DUR seconds then back down over another "
+                        "DUR (so '2:20:60' is a 10x swing over 120 s); "
+                        "overrides --rate/--duration-s and exports "
+                        "serve_ramp_p99_ms")
     parser.add_argument("--p99-budget-ms", type=float, default=2000.0)
     parser.add_argument("--error-slo", type=float, default=0.01,
                         help="max tolerated non-OK fraction")
@@ -453,17 +550,27 @@ def main(argv=None) -> int:
                 replica_kill_at_request=opts.kill_replica_at
             )
         )
+    rate_qps, duration_s, arrivals = opts.rate, opts.duration_s, None
+    if opts.ramp:
+        try:
+            segments = parse_ramp(opts.ramp)
+        except ValueError as exc:
+            parser.error(str(exc))
+        arrivals = ramp_arrivals(segments, seed=opts.seed)
+        _, duration_s, rate_qps = ramp_rate_fn(segments)
     try:
         result = run_loadtest(
             target,
             episodes,
-            rate_qps=opts.rate,
-            duration_s=opts.duration_s,
+            rate_qps=rate_qps,
+            duration_s=duration_s,
             p99_budget_ms=opts.p99_budget_ms,
             error_slo=opts.error_slo,
             timeout_s=opts.timeout_s,
             seed=opts.seed,
             tag_seed_base=opts.tag_seed_base,
+            arrivals=arrivals,
+            ramp=opts.ramp or None,
         )
     finally:
         if opts.kill_replica_at is not None:
